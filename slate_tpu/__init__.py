@@ -1,0 +1,23 @@
+"""slate_tpu — a TPU-native distributed dense linear algebra framework.
+
+A brand-new JAX/XLA/Pallas re-design with the capabilities of SLATE (Software for Linear
+Algebra Targeting Exascale): tiled distributed matrices with pluggable 2D block-cyclic
+layouts, parallel BLAS-3, linear solvers (Cholesky / LU variants / mixed-precision
+iterative refinement / band), least squares (QR, CholQR), and eigenvalue/SVD drivers —
+where a ``jax.sharding.Mesh`` over a TPU pod slice replaces the reference's MPI process
+grid, ICI collectives replace tile broadcasts, and XLA/Pallas kernels replace
+cuBLAS/CUDA (see SURVEY.md for the layer-by-layer mapping).
+
+Public API mirrors ``include/slate/slate.hh`` (~92 routines) with snake_case names; the
+verb-style convenience layer mirroring ``include/slate/simplified_api.hh`` lives in
+:mod:`slate_tpu.simplified`.
+"""
+
+from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
+                   HermitianMatrix, Layout, Matrix, MethodCholQR, MethodEig, MethodGels,
+                   MethodGemm, MethodHemm, MethodLU, MethodSVD, MethodTrsm, Norm,
+                   NormScope, Op, Options, Side, SlateError, SymmetricMatrix, Target,
+                   TileKind, TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
+                   Uplo, func)
+
+__version__ = "0.1.0"

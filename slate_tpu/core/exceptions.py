@@ -1,0 +1,17 @@
+"""Exceptions (reference: include/slate/Exception.hh:1-126).
+
+The reference wraps MPI errors (`internal/mpi.hh:10-37`); here there is no MPI — JAX/XLA
+errors propagate natively — so only the library-level exception and assert helper remain.
+"""
+
+from __future__ import annotations
+
+
+class SlateError(RuntimeError):
+    """Library error (reference slate_error / SLATE Exception.hh:1-60)."""
+
+
+def slate_assert(cond: bool, msg: str = "") -> None:
+    """Check a library invariant (reference slate_assert, Exception.hh:100-126)."""
+    if not cond:
+        raise SlateError(msg or "assertion failed")

@@ -1,0 +1,515 @@
+"""Distributed tiled matrix types — the L2 runtime.
+
+TPU-native re-design of the reference's matrix hierarchy:
+
+- ``BaseMatrix`` (include/slate/BaseMatrix.hh, 3976 LoC) — views, tile access, offsets
+- ``Matrix`` / ``TrapezoidMatrix`` / ``TriangularMatrix`` / ``SymmetricMatrix`` /
+  ``HermitianMatrix`` / band variants (include/slate/*.hh, ~5400 LoC)
+- ``MatrixStorage`` (include/slate/internal/MatrixStorage.hh) — the distributed tile map
+
+Re-design rationale (TPU-first):
+
+* The reference stores a ``std::map<(i,j) -> TileNode>`` of individually-allocated tiles
+  with a MOSI host/device coherence protocol (BaseMatrix.hh:2640-2718).  On TPU a matrix
+  is **one jax.Array resident in HBM**, optionally sharded over a ``jax.sharding.Mesh``;
+  XLA manages placement and there is exactly one device copy per shard, so the entire
+  MOSI state machine disappears.  What survives is the *metadata*: the tile grid
+  (mb/nb/rank lambdas, MatrixStorage.hh:339-342) and cheap views.
+
+* Views are index arithmetic, exactly like the reference: ``sub`` (BaseMatrix.hh:104-106)
+  and ``slice`` (BaseMatrix.hh:110-121) share storage; ``transpose`` is a flag flip
+  (Tile.hh:40-52).  Because jax.Arrays are immutable, "mutation" of a view functionally
+  rebinds the shared :class:`MatrixStorage` array with an ``.at[].set`` — drivers keep
+  their hot loops inside jit over raw arrays and only touch these wrappers at API
+  boundaries.
+
+* Distribution: ``MatrixStorage`` carries the tile->rank lambda (2D block-cyclic default,
+  func.hh:100-217) and an optional :class:`~slate_tpu.parallel.mesh.ProcessGrid`; the
+  actual sharding of the jax.Array is applied by ``parallel/distribute.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import grid as grid_funcs
+from .exceptions import SlateError, slate_assert
+from .types import Diag, GridOrder, Op, TileKind, Uplo
+
+
+class MatrixStorage:
+    """Shared storage for a family of views (reference MatrixStorage.hh:150-1156).
+
+    Holds the backing jax.Array (global logical matrix, untransposed), the tile-size
+    lambdas, the tile->rank distribution lambda, and the optional process grid.  All
+    views of one matrix hold a reference to one instance (BaseMatrix.hh:789-790
+    ``shared_ptr<MatrixStorage>``).
+    """
+
+    __slots__ = ("array", "mb", "nb", "tile_rank", "grid", "kind", "p", "q", "order")
+
+    def __init__(self, array: jax.Array, mb: int, nb: int,
+                 p: int = 1, q: int = 1, order: GridOrder = GridOrder.Col,
+                 grid: Any = None, kind: TileKind = TileKind.SlateOwned,
+                 tile_rank: Optional[grid_funcs.TileRankFunc] = None):
+        self.array = array
+        self.mb = int(mb)
+        self.nb = int(nb)
+        self.p = int(p)
+        self.q = int(q)
+        self.order = GridOrder.from_string(order)
+        self.tile_rank = tile_rank or grid_funcs.process_2d_grid(self.order, self.p, self.q)
+        self.grid = grid          # ProcessGrid (parallel/mesh.py) or None
+        self.kind = kind
+
+    @property
+    def m(self) -> int:
+        return self.array.shape[-2]
+
+    @property
+    def n(self) -> int:
+        return self.array.shape[-1]
+
+    def update(self, row0: int, col0: int, block: jax.Array) -> None:
+        """Functionally write ``block`` into the backing array at (row0, col0)."""
+        if row0 == 0 and col0 == 0 and block.shape == self.array.shape:
+            self.array = block
+        else:
+            self.array = self.array.at[row0:row0 + block.shape[-2],
+                                       col0:col0 + block.shape[-1]].set(block)
+
+
+class BaseMatrix:
+    """Shared view machinery for all matrix types (BaseMatrix.hh:39-795).
+
+    A view is (storage, ioffset, joffset, m, n, op); ``uplo``/``diag`` live on the typed
+    subclasses.  Offsets and extents are in **elements** of the untransposed storage.
+    """
+
+    uplo: Uplo = Uplo.General
+    diag: Diag = Diag.NonUnit
+
+    def __init__(self, storage: MatrixStorage, ioffset: int, joffset: int,
+                 m: int, n: int, op: Op = Op.NoTrans):
+        self.storage = storage
+        self.ioffset = int(ioffset)
+        self.joffset = int(joffset)
+        self._m = int(m)   # extent in *storage* coordinates (before op)
+        self._n = int(n)
+        self.op = op
+
+    # ----- shape ---------------------------------------------------------------
+    @property
+    def m(self) -> int:
+        """Logical row count (after op), BaseMatrix.hh m()."""
+        return self._n if self.op != Op.NoTrans else self._m
+
+    @property
+    def n(self) -> int:
+        return self._m if self.op != Op.NoTrans else self._n
+
+    @property
+    def mb(self) -> int:
+        return self.storage.nb if self.op != Op.NoTrans else self.storage.mb
+
+    @property
+    def nb(self) -> int:
+        return self.storage.mb if self.op != Op.NoTrans else self.storage.nb
+
+    @property
+    def mt(self) -> int:
+        """Row tile count (BaseMatrix.hh mt())."""
+        return grid_funcs.num_tiles(self.m, self.mb)
+
+    @property
+    def nt(self) -> int:
+        return grid_funcs.num_tiles(self.n, self.nb)
+
+    def tileMb(self, i: int) -> int:
+        return grid_funcs.uniform_blocksize(self.m, self.mb)(i)
+
+    def tileNb(self, j: int) -> int:
+        return grid_funcs.uniform_blocksize(self.n, self.nb)(j)
+
+    def tileRank(self, i: int, j: int) -> int:
+        """Tile owner rank in the flattened p×q grid (MatrixStorage.hh:339).
+
+        Only meaningful on tile-aligned views (anything built via ctor/sub/transpose);
+        a ``slice`` at a non-tile-aligned offset has no well-defined tile->rank map.
+        """
+        slate_assert(self.ioffset % self.storage.mb == 0
+                     and self.joffset % self.storage.nb == 0,
+                     "tileRank on a non-tile-aligned slice view")
+        if self.op != Op.NoTrans:
+            i, j = j, i
+        return self.storage.tile_rank(self.ioffset // self.storage.mb + i,
+                                      self.joffset // self.storage.nb + j)
+
+    def tileIsLocal(self, i: int, j: int) -> bool:
+        g = self.storage.grid
+        rank = 0 if g is None else getattr(g, "rank", 0)
+        return self.tileRank(i, j) == rank
+
+    @property
+    def dtype(self):
+        return self.storage.array.dtype
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.m, self.n)
+
+    def gridinfo(self) -> Tuple[GridOrder, int, int]:
+        """(order, p, q) of the process grid (BaseMatrix.hh:161-164)."""
+        return self.storage.order, self.storage.p, self.storage.q
+
+    # ----- data access ---------------------------------------------------------
+    @property
+    def array(self) -> jax.Array:
+        """Materialize the logical view (op applied). Read side of tileGetForReading."""
+        a = self.storage.array[..., self.ioffset:self.ioffset + self._m,
+                               self.joffset:self.joffset + self._n]
+        if self.op == Op.Trans:
+            a = jnp.swapaxes(a, -1, -2)
+        elif self.op == Op.ConjTrans:
+            a = jnp.conj(jnp.swapaxes(a, -1, -2))
+        return a
+
+    def set_array(self, value: jax.Array) -> None:
+        """Write the logical view back to shared storage (write side of
+        tileGetForWriting; functional update under the hood)."""
+        value = jnp.asarray(value)
+        slate_assert(value.shape[-2:] == (self.m, self.n),
+                     f"shape mismatch: view {self.shape}, value {value.shape}")
+        if self.op == Op.Trans:
+            value = jnp.swapaxes(value, -1, -2)
+        elif self.op == Op.ConjTrans:
+            value = jnp.conj(jnp.swapaxes(value, -1, -2))
+        self.storage.update(self.ioffset, self.joffset, value)
+
+    def __call__(self, i: int, j: int) -> jax.Array:
+        """Read tile (i, j) — the reference's ``A(i, j)`` tile accessor."""
+        return self.tile(i, j)
+
+    def _tile_storage_coords(self, i: int, j: int):
+        """Map logical tile (i, j) to a storage-coordinate slice (op un-applied)."""
+        mb_log, nb_log = self.tileMb(i), self.tileNb(j)
+        io, jo = i * self.mb, j * self.nb
+        if self.op != Op.NoTrans:
+            io, jo = jo, io
+            mb_log, nb_log = nb_log, mb_log
+        return (self.ioffset + io, self.joffset + jo, mb_log, nb_log)
+
+    def tile(self, i: int, j: int) -> jax.Array:
+        """Slices storage directly and applies op to the single tile — never
+        materializes the whole op-applied view."""
+        io, jo, mb_s, nb_s = self._tile_storage_coords(i, j)
+        t = self.storage.array[..., io:io + mb_s, jo:jo + nb_s]
+        if self.op == Op.Trans:
+            t = jnp.swapaxes(t, -1, -2)
+        elif self.op == Op.ConjTrans:
+            t = jnp.conj(jnp.swapaxes(t, -1, -2))
+        return t
+
+    def set_tile(self, i: int, j: int, value: jax.Array) -> None:
+        io, jo, mb_s, nb_s = self._tile_storage_coords(i, j)
+        value = jnp.asarray(value)
+        slate_assert(value.shape[-2:] == ((nb_s, mb_s) if self.op != Op.NoTrans
+                                          else (mb_s, nb_s)),
+                     f"tile shape mismatch at ({i},{j})")
+        if self.op == Op.Trans:
+            value = jnp.swapaxes(value, -1, -2)
+        elif self.op == Op.ConjTrans:
+            value = jnp.conj(jnp.swapaxes(value, -1, -2))
+        self.storage.update(io, jo, value)
+
+    # ----- views ---------------------------------------------------------------
+    def _make_view(self, ioffset, joffset, m, n, op) -> "BaseMatrix":
+        view = object.__new__(type(self))
+        BaseMatrix.__init__(view, self.storage, ioffset, joffset, m, n, op)
+        # carry typed attributes
+        view.uplo = getattr(self, "uplo", Uplo.General)
+        view.diag = getattr(self, "diag", Diag.NonUnit)
+        for attr in ("_kl", "_ku", "kd"):
+            if hasattr(self, attr):
+                setattr(view, attr, getattr(self, attr))
+        return view
+
+    def sub(self, i1: int, i2: int, j1: int, j2: int) -> "BaseMatrix":
+        """Sub-matrix over inclusive tile indices [i1..i2] x [j1..j2]
+        (BaseMatrix.hh:104-106). Offsets must stay tile-aligned, which they do by
+        construction since views are built from tile indices."""
+        slate_assert(0 <= i1 and i2 < self.mt and 0 <= j1 and j2 < self.nt,
+                     f"sub({i1},{i2},{j1},{j2}) out of range {self.mt}x{self.nt}")
+        m = sum(self.tileMb(i) for i in range(i1, i2 + 1))
+        n = sum(self.tileNb(j) for j in range(j1, j2 + 1))
+        io, jo = i1 * self.mb, j1 * self.nb
+        if self.op != Op.NoTrans:
+            io, jo, m, n = jo, io, n, m
+        return self._make_view(self.ioffset + io, self.joffset + jo, m, n, self.op)
+
+    def slice(self, row1: int, row2: int, col1: int, col2: int) -> "BaseMatrix":
+        """Sub-matrix over inclusive element indices (BaseMatrix.hh:110-121)."""
+        slate_assert(0 <= row1 <= row2 < self.m and 0 <= col1 <= col2 < self.n,
+                     f"slice({row1},{row2},{col1},{col2}) out of range "
+                     f"{self.m}x{self.n}")
+        m, n = row2 - row1 + 1, col2 - col1 + 1
+        io, jo = row1, col1
+        if self.op != Op.NoTrans:
+            io, jo, m, n = jo, io, n, m
+        return self._make_view(self.ioffset + io, self.joffset + jo, m, n, self.op)
+
+    def transpose(self) -> "BaseMatrix":
+        """Logical transpose — a flag flip, no data motion (Tile.hh:40-52)."""
+        op = {Op.NoTrans: Op.Trans, Op.Trans: Op.NoTrans,
+              Op.ConjTrans: Op.ConjTrans}[self.op]
+        if self.op == Op.ConjTrans:
+            raise SlateError("transpose of conj-transposed view not supported; "
+                             "matches reference restriction")
+        v = self._make_view(self.ioffset, self.joffset, self._m, self._n, op)
+        v.uplo = _flip_uplo(self.uplo)
+        return v
+
+    def conj_transpose(self) -> "BaseMatrix":
+        op = {Op.NoTrans: Op.ConjTrans, Op.ConjTrans: Op.NoTrans,
+              Op.Trans: Op.NoTrans}[self.op]
+        if self.op == Op.Trans:
+            raise SlateError("conj_transpose of transposed view not supported")
+        v = self._make_view(self.ioffset, self.joffset, self._m, self._n, op)
+        v.uplo = _flip_uplo(self.uplo)
+        return v
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def H(self):
+        return self.conj_transpose()
+
+    def __repr__(self) -> str:
+        extra = "" if self.uplo == Uplo.General else f", uplo={self.uplo}"
+        return (f"{type(self).__name__}({self.m}x{self.n}, mb={self.mb}, nb={self.nb}, "
+                f"mt={self.mt}, nt={self.nt}, op={self.op}{extra}, dtype={self.dtype})")
+
+
+def _flip_uplo(uplo: Uplo) -> Uplo:
+    if uplo == Uplo.Lower:
+        return Uplo.Upper
+    if uplo == Uplo.Upper:
+        return Uplo.Lower
+    return uplo
+
+
+# ---------------------------------------------------------------------------
+# Typed matrices
+# ---------------------------------------------------------------------------
+
+
+class Matrix(BaseMatrix):
+    """General m×n matrix (include/slate/Matrix.hh:31-164)."""
+
+    def __init__(self, m: int, n: int, nb: int, p: int = 1, q: int = 1,
+                 mb: Optional[int] = None, order: GridOrder = GridOrder.Col,
+                 grid: Any = None, dtype=jnp.float32, _storage: MatrixStorage = None):
+        if _storage is not None:
+            BaseMatrix.__init__(self, _storage, 0, 0, _storage.m, _storage.n)
+            return
+        mb = mb or nb
+        array = jnp.zeros((m, n), dtype=dtype)
+        storage = MatrixStorage(array, mb, nb, p, q, order, grid)
+        BaseMatrix.__init__(self, storage, 0, 0, m, n)
+
+    @classmethod
+    def from_array(cls, a, nb: int = 256, p: int = 1, q: int = 1,
+                   mb: Optional[int] = None, order: GridOrder = GridOrder.Col,
+                   grid: Any = None) -> "Matrix":
+        """Wrap existing data (reference fromLAPACK, Matrix.hh:293; the array is adopted
+        as UserOwned origin data)."""
+        a = jnp.asarray(a)
+        slate_assert(a.ndim == 2, "from_array expects a 2-D array")
+        storage = MatrixStorage(a, mb or nb, nb, p, q, order, grid,
+                                kind=TileKind.UserOwned)
+        return cls(0, 0, nb, _storage=storage)
+
+    def empty_like(self, m: Optional[int] = None, n: Optional[int] = None,
+                   nb: Optional[int] = None, dtype=None) -> "Matrix":
+        """New zeroed matrix with this one's distribution (Matrix.hh emptyLike:117)."""
+        s = self.storage
+        return Matrix(self.m if m is None else m, self.n if n is None else n,
+                      nb or self.nb, s.p, s.q, order=s.order, grid=s.grid,
+                      dtype=dtype or self.dtype)
+
+
+class BaseTrapezoidMatrix(BaseMatrix):
+    """Upper/lower trapezoidal storage view (include/slate/BaseTrapezoidMatrix.hh)."""
+
+    def __init__(self, uplo: Uplo, m: int = 0, n: int = 0, nb: int = 256, p: int = 1, q: int = 1,
+                 order: GridOrder = GridOrder.Col, grid: Any = None,
+                 dtype=jnp.float32, _storage: MatrixStorage = None,
+                 diag: Diag = Diag.NonUnit):
+        if _storage is not None:
+            BaseMatrix.__init__(self, _storage, 0, 0, _storage.m, _storage.n)
+        else:
+            array = jnp.zeros((m, n), dtype=dtype)
+            storage = MatrixStorage(array, nb, nb, p, q, order, grid)
+            BaseMatrix.__init__(self, storage, 0, 0, m, n)
+        self.uplo = Uplo.from_string(uplo)
+        self.diag = Diag.from_string(diag)
+        slate_assert(self.uplo in (Uplo.Lower, Uplo.Upper), "uplo must be lower/upper")
+
+    @classmethod
+    def from_array(cls, uplo, a, nb: int = 256, p: int = 1, q: int = 1,
+                   order: GridOrder = GridOrder.Col, grid: Any = None, **kw):
+        a = jnp.asarray(a)
+        storage = MatrixStorage(a, nb, nb, p, q, order, grid, kind=TileKind.UserOwned)
+        return cls(uplo, _storage=storage, **kw)
+
+    def masked_array(self) -> jax.Array:
+        """The logical view with the unreferenced triangle zeroed (and unit diagonal
+        substituted if diag == Unit) — the compute-side canonical form."""
+        a = self.array
+        if self.uplo == Uplo.Lower:
+            a = jnp.tril(a)
+        else:
+            a = jnp.triu(a)
+        if self.diag == Diag.Unit:
+            eye = jnp.eye(a.shape[-2], a.shape[-1], dtype=jnp.bool_)
+            a = jnp.where(eye, jnp.ones((), dtype=a.dtype), a)
+        return a
+
+
+class TrapezoidMatrix(BaseTrapezoidMatrix):
+    """include/slate/TrapezoidMatrix.hh."""
+
+
+class TriangularMatrix(BaseTrapezoidMatrix):
+    """Square triangular matrix (include/slate/TriangularMatrix.hh, 684 LoC)."""
+
+    def __init__(self, uplo, n: int = 0, nb: int = 256, *args, **kw):
+        super().__init__(uplo, n, n, nb, *args, **kw)
+
+
+class SymmetricMatrix(BaseTrapezoidMatrix):
+    """Symmetric matrix, one triangle stored (include/slate/SymmetricMatrix.hh)."""
+
+    def __init__(self, uplo, n: int = 0, nb: int = 256, *args, **kw):
+        super().__init__(uplo, n, n, nb, *args, **kw)
+
+    def full_array(self) -> jax.Array:
+        """Symmetrize from the stored triangle: A = tril(A) + tril(A,-1)^T etc."""
+        a = self.array
+        if self.uplo == Uplo.Lower:
+            lower = jnp.tril(a)
+            return lower + jnp.swapaxes(jnp.tril(a, -1), -1, -2)
+        upper = jnp.triu(a)
+        return upper + jnp.swapaxes(jnp.triu(a, 1), -1, -2)
+
+
+class HermitianMatrix(BaseTrapezoidMatrix):
+    """Hermitian matrix (include/slate/HermitianMatrix.hh)."""
+
+    def __init__(self, uplo, n: int = 0, nb: int = 256, *args, **kw):
+        super().__init__(uplo, n, n, nb, *args, **kw)
+
+    def full_array(self) -> jax.Array:
+        a = self.array
+        if self.uplo == Uplo.Lower:
+            strict = jnp.tril(a, -1)
+            diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+        else:
+            strict = jnp.triu(a, 1)
+            diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+        if jnp.iscomplexobj(a):
+            diag = jnp.real(diag).astype(a.dtype)
+            herm = jnp.conj(jnp.swapaxes(strict, -1, -2))
+        else:
+            herm = jnp.swapaxes(strict, -1, -2)
+        full = strict + herm
+        idx = jnp.arange(a.shape[-1])
+        return full.at[..., idx, idx].set(diag)
+
+
+class BaseBandMatrix(BaseMatrix):
+    """Band matrix base (include/slate/BaseBandMatrix.hh, 368 LoC).
+
+    TPU note: the reference stores only tiles within the band; here the backing array is
+    dense with (kl, ku) metadata — XLA has no ragged storage — but band drivers only
+    touch elements inside the band, and packed band storage is provided by
+    ``slate_tpu.linalg.band`` for the band factorizations."""
+
+    def __init__(self, m, n, kl, ku, nb, p=1, q=1, order=GridOrder.Col, grid=None,
+                 dtype=jnp.float32, _storage=None):
+        if _storage is not None:
+            BaseMatrix.__init__(self, _storage, 0, 0, _storage.m, _storage.n)
+        else:
+            array = jnp.zeros((m, n), dtype=dtype)
+            storage = MatrixStorage(array, nb, nb, p, q, order, grid)
+            BaseMatrix.__init__(self, storage, 0, 0, m, n)
+        self._kl = int(kl)   # storage-orientation bandwidths
+        self._ku = int(ku)
+
+    @property
+    def kl(self) -> int:
+        """Logical lower bandwidth (swaps with ku on transposed views)."""
+        return self._ku if self.op != Op.NoTrans else self._kl
+
+    @property
+    def ku(self) -> int:
+        return self._kl if self.op != Op.NoTrans else self._ku
+
+    def band_mask(self) -> jax.Array:
+        r = jnp.arange(self.m)[:, None]
+        c = jnp.arange(self.n)[None, :]
+        return (c - r <= self.ku) & (r - c <= self.kl)
+
+    def masked_array(self) -> jax.Array:
+        return jnp.where(self.band_mask(), self.array, 0)
+
+
+class BandMatrix(BaseBandMatrix):
+    """include/slate/BandMatrix.hh (265 LoC)."""
+
+
+class TriangularBandMatrix(BaseBandMatrix):
+    """include/slate/TriangularBandMatrix.hh (374 LoC, incl. ge2tbGather:327)."""
+
+    def __init__(self, uplo, n, kd, nb, **kw):
+        uplo = Uplo.from_string(uplo)
+        kl, ku = (kd, 0) if uplo == Uplo.Lower else (0, kd)
+        super().__init__(n, n, kl, ku, nb, **kw)
+        self.uplo = uplo
+        self.kd = kd
+
+
+class HermitianBandMatrix(BaseBandMatrix):
+    """include/slate/HermitianBandMatrix.hh (358 LoC, incl. he2hbGather:310)."""
+
+    def __init__(self, uplo, n, kd, nb, **kw):
+        uplo = Uplo.from_string(uplo)
+        kl, ku = (kd, 0) if uplo == Uplo.Lower else (0, kd)
+        super().__init__(n, n, kl, ku, nb, **kw)
+        self.uplo = uplo
+        self.kd = kd
+
+
+# ---------------------------------------------------------------------------
+# Helpers used across drivers
+# ---------------------------------------------------------------------------
+
+
+def as_array(A) -> jax.Array:
+    """Accept Matrix-likes or raw arrays at API boundaries; return the logical array."""
+    if isinstance(A, BaseMatrix):
+        return A.array
+    return jnp.asarray(A)
+
+
+def write_back(A, value: jax.Array):
+    """Write a driver result back into a Matrix wrapper (no-op passthrough for raw
+    arrays — the functional-style API returns the value either way)."""
+    if isinstance(A, BaseMatrix):
+        A.set_array(value)
+    return value

@@ -1,0 +1,292 @@
+"""Enums and per-call options.
+
+TPU-native re-design of the reference's enum/option system
+(``include/slate/enums.hh:38-498``, ``include/slate/types.hh:32-271``).
+
+The reference passes a ``std::map<Option, OptionValue>`` to every driver; here we use a
+frozen dataclass :class:`Options` with typed fields and an ``opts.replace(...)`` /
+``Options(**dict)`` interface.  Every enum supports the same string round-trip the
+reference provides via ``to_string``/``from_string`` helpers (``enums.hh:61-455``):
+``Op.from_string("t") == Op.Trans`` and ``str(Op.Trans) == "trans"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, Optional
+
+
+class _StrEnum(enum.Enum):
+    """Enum with case-insensitive string round trip (mirrors enums.hh *2str/str2* pairs)."""
+
+    def __str__(self) -> str:  # noqa: D105
+        return self.value
+
+    @classmethod
+    def from_string(cls, s: "str | _StrEnum"):
+        if isinstance(s, cls):
+            return s
+        key = str(s).strip().lower()
+        for member in cls:
+            if member.value == key or member.name.lower() == key:
+                return member
+        # single-letter shorthands used throughout the reference tester CLI
+        short = getattr(cls, "_shorthand", None)
+        if short is not None and key in short:
+            return short[key]
+        raise ValueError(f"no {cls.__name__} named {s!r}")
+
+
+class Op(_StrEnum):
+    """Transposition flag (enums.hh via blaspp; Tile.hh:40-52 makes transpose a flag flip)."""
+
+    NoTrans = "notrans"
+    Trans = "trans"
+    ConjTrans = "conjtrans"
+
+
+Op._shorthand = {"n": Op.NoTrans, "t": Op.Trans, "c": Op.ConjTrans}
+
+
+class Uplo(_StrEnum):
+    """Which triangle is referenced (blaspp enum used pervasively in BaseMatrix)."""
+
+    Upper = "upper"
+    Lower = "lower"
+    General = "general"
+
+
+Uplo._shorthand = {"u": Uplo.Upper, "l": Uplo.Lower, "g": Uplo.General}
+
+
+class Diag(_StrEnum):
+    NonUnit = "nonunit"
+    Unit = "unit"
+
+
+Diag._shorthand = {"n": Diag.NonUnit, "u": Diag.Unit}
+
+
+class Side(_StrEnum):
+    Left = "left"
+    Right = "right"
+
+
+Side._shorthand = {"l": Side.Left, "r": Side.Right}
+
+
+class Layout(_StrEnum):
+    """Physical tile layout (Tile.hh). On TPU XLA owns layout; kept for API parity only."""
+
+    ColMajor = "colmajor"
+    RowMajor = "rowmajor"
+
+
+class Norm(_StrEnum):
+    """Matrix norm kind (matches lapack norms used by internal_genorm.cc etc.)."""
+
+    One = "one"
+    Two = "two"
+    Inf = "inf"
+    Fro = "fro"
+    Max = "max"
+
+
+Norm._shorthand = {"1": Norm.One, "o": Norm.One, "2": Norm.Two, "i": Norm.Inf,
+                   "f": Norm.Fro, "m": Norm.Max}
+
+
+class NormScope(_StrEnum):
+    """Scope of a norm computation (enums.hh NormScope; Columns used by colNorms)."""
+
+    Columns = "columns"
+    Rows = "rows"
+    Matrix = "matrix"
+
+
+class Target(_StrEnum):
+    """Execution target (enums.hh:38-44).
+
+    The reference has {HostTask, HostNest, HostBatch, Devices}. On TPU there is a single
+    compute fabric, so the meaningful split is how the computation is laid out:
+
+    - ``Auto``: let each driver pick.
+    - ``XLA``: whole-matrix XLA primitive (e.g. lax.linalg.cholesky) — the analogue of a
+      single fused vendor call.
+    - ``Tiled``: our blocked/tiled driver loop (the analogue of the task-DAG drivers);
+      required for distributed execution and the path that honors nb/lookahead options.
+    """
+
+    Auto = "auto"
+    XLA = "xla"
+    Tiled = "tiled"
+    # accepted aliases for reference CLI parity (`--target t/d` etc.)
+
+
+Target._shorthand = {"t": Target.Tiled, "d": Target.Tiled, "h": Target.XLA,
+                     "x": Target.XLA, "a": Target.Auto}
+
+
+class TileKind(_StrEnum):
+    """Tile provenance (Tile.hh:97-101). Informational on TPU (buffers are jax.Arrays)."""
+
+    Workspace = "workspace"
+    SlateOwned = "slateowned"
+    UserOwned = "userowned"
+
+
+class GridOrder(_StrEnum):
+    """Process-grid ordering (enums.hh GridOrder; func.hh:178-217)."""
+
+    Col = "col"
+    Row = "row"
+    Unknown = "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Method enums — algorithmic variant selectors (enums.hh:108-455)
+# ---------------------------------------------------------------------------
+
+
+class MethodGemm(_StrEnum):
+    """Stationary-matrix choice for gemm (enums.hh:108-114; src/gemm.cc:12-24)."""
+
+    Auto = "auto"
+    A = "a"          # stationary A (gemmA)
+    C = "c"          # stationary C (gemmC)
+    SUMMA = "summa"  # TPU addition: explicit shard_map SUMMA pipeline
+
+
+class MethodHemm(_StrEnum):
+    Auto = "auto"
+    A = "a"
+    C = "c"
+
+
+class MethodTrsm(_StrEnum):
+    Auto = "auto"
+    A = "a"
+    B = "b"
+
+
+class MethodLU(_StrEnum):
+    """LU pivoting variant (enums.hh:302-309)."""
+
+    Auto = "auto"
+    PartialPiv = "partialpiv"
+    CALU = "calu"        # tournament pivoting (getrf_tntpiv)
+    NoPiv = "nopiv"
+    RBT = "rbt"          # random butterfly transform + nopiv
+    BEAM = "beam"
+
+
+class MethodEig(_StrEnum):
+    """Tridiagonal eigensolver (enums.hh MethodEig: QR iteration vs divide & conquer)."""
+
+    Auto = "auto"
+    QR = "qr"       # steqr
+    DC = "dc"       # stedc
+    Bisection = "bisection"
+    MRRR = "mrrr"
+
+
+class MethodSVD(_StrEnum):
+    Auto = "auto"
+    QR = "qr"       # bdsqr
+    DC = "dc"
+    Bisection = "bisection"
+
+
+class MethodCholQR(_StrEnum):
+    """Inner product method for CholQR (enums.hh MethodCholQR)."""
+
+    Auto = "auto"
+    GemmA = "gemma"
+    GemmC = "gemmc"
+    HerkA = "herka"
+    HerkC = "herkc"
+
+
+class MethodGels(_StrEnum):
+    """Least-squares factorization choice (enums.hh MethodGels)."""
+
+    Auto = "auto"
+    QR = "qr"
+    CholQR = "cholqr"
+
+
+# ---------------------------------------------------------------------------
+# Options
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Options:
+    """Per-call options (types.hh:32-81; option keys enums.hh:461-498).
+
+    All drivers accept ``opts: Options | dict | None``. Unknown dict keys raise, like the
+    reference's typed ``get_option<Option::X>`` (types.hh:240-271).
+    """
+
+    lookahead: int = 1
+    block_size: int = 256           # Option::BlockSize (nb)
+    inner_blocking: int = 32        # Option::InnerBlocking (ib)
+    max_panel_threads: int = 1      # kept for parity; no host thread teams on TPU
+    tolerance: Optional[float] = None  # Option::Tolerance (mixed-precision IR)
+    max_iterations: int = 30        # Option::MaxIterations (IR)
+    use_fallback_solver: bool = True  # Option::UseFallbackSolver (gesv_mixed.cc:93-96)
+    pivot_threshold: float = 1.0    # Option::PivotThreshold
+    depth: int = 2                  # Option::Depth (RBT butterfly depth, gesv_rbt.cc)
+    target: Target = Target.Auto
+    hold_local_workspace: bool = False  # parity only
+    print_verbose: int = 0          # Option::PrintVerbose (enums.hh:477-488)
+    print_edgeitems: int = 16
+    print_width: int = 10
+    print_precision: int = 4
+    # method selectors
+    method_gemm: MethodGemm = MethodGemm.Auto
+    method_hemm: MethodHemm = MethodHemm.Auto
+    method_trsm: MethodTrsm = MethodTrsm.Auto
+    method_lu: MethodLU = MethodLU.Auto
+    method_eig: MethodEig = MethodEig.Auto
+    method_svd: MethodSVD = MethodSVD.Auto
+    method_cholqr: MethodCholQR = MethodCholQR.Auto
+    method_gels: MethodGels = MethodGels.Auto
+    # TPU-specific knobs (no reference analogue)
+    precision: Optional[Any] = None   # compute dtype override (e.g. jnp.bfloat16)
+    factor_precision: Optional[Any] = None  # low precision for *_mixed factor step
+
+    def replace(self, **kw) -> "Options":
+        kw = {k: _coerce_option(k, v) for k, v in kw.items()}
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def make(cls, opts: "Options | Dict[str, Any] | None") -> "Options":
+        if opts is None:
+            return cls()
+        if isinstance(opts, Options):
+            return opts
+        if isinstance(opts, dict):
+            return cls().replace(**opts)
+        raise TypeError(f"opts must be Options, dict, or None, got {type(opts)}")
+
+
+_ENUM_FIELDS = {
+    "target": Target,
+    "method_gemm": MethodGemm,
+    "method_hemm": MethodHemm,
+    "method_trsm": MethodTrsm,
+    "method_lu": MethodLU,
+    "method_eig": MethodEig,
+    "method_svd": MethodSVD,
+    "method_cholqr": MethodCholQR,
+    "method_gels": MethodGels,
+}
+
+
+def _coerce_option(key: str, value: Any) -> Any:
+    cls = _ENUM_FIELDS.get(key)
+    if cls is not None and not isinstance(value, cls):
+        return cls.from_string(value)
+    return value

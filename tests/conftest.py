@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Distributed behavior is tested the way the reference tests MPI with ``mpirun -np 4`` on
+one node (SURVEY.md §4): a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8`` — the same SPMD code path, small world size.
+Numerical checks run in float64 on CPU (x64 enabled), matching the reference's double-
+precision residual gates; TPU runs use f32/bf16 (see bench.py).
+"""
+
+import os
+
+# Must be set before jax initializes its backends. The ambient environment pins
+# JAX_PLATFORMS to the real TPU platform; tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# If a TPU PJRT plugin was registered by a sitecustomize hook, drop it so tests never
+# touch the (single-session) real-TPU tunnel: tests run on the virtual CPU mesh only.
+try:  # pragma: no cover - environment-specific
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

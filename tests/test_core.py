@@ -1,0 +1,155 @@
+"""Core matrix/grid tests (reference: unit_test/test_Matrix.cc 2160 LoC scope:
+ctors, sub/slice/transpose, tile metadata; unit_test/test_func.cc for grid maps)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu.core import func
+
+
+def test_uniform_blocksize():
+    mb = func.uniform_blocksize(10, 4)
+    assert [mb(i) for i in range(3)] == [4, 4, 2]
+    assert func.num_tiles(10, 4) == 3
+    assert func.num_tiles(8, 4) == 2
+    assert func.num_tiles(0, 4) == 0
+
+
+def test_process_2d_grid():
+    f = func.process_2d_grid("col", 2, 3)
+    # col-major: rank = i%p + (j%q)*p
+    assert f(0, 0) == 0 and f(1, 0) == 1 and f(0, 1) == 2 and f(1, 2) == 5
+    assert f(2, 3) == f(0, 0)
+    g = func.process_2d_grid("row", 2, 3)
+    assert g(0, 1) == 1 and g(1, 0) == 3
+    ok, order, p, q = func.is_2d_cyclic_grid(8, 8, f)
+    assert ok and p == 2 and q == 3
+    assert func.grid_size(8) == (2, 4)
+    assert func.grid_size(9) == (3, 3)
+
+
+def test_matrix_ctor_and_tiles():
+    A = slate.Matrix(10, 7, nb=4, dtype=jnp.float64)
+    assert A.shape == (10, 7) and A.mt == 3 and A.nt == 2
+    assert A.tileMb(2) == 2 and A.tileNb(1) == 3
+    a = np.arange(70, dtype=np.float64).reshape(10, 7)
+    A = slate.Matrix.from_array(a, nb=4)
+    np.testing.assert_array_equal(np.asarray(A.tile(1, 1)), a[4:8, 4:7])
+    np.testing.assert_array_equal(np.asarray(A.array), a)
+
+
+def test_sub_and_slice_share_storage():
+    a = np.arange(64, dtype=np.float64).reshape(8, 8)
+    A = slate.Matrix.from_array(a, nb=4)
+    S = A.sub(1, 1, 0, 1)        # tile row 1, all col tiles
+    assert S.shape == (4, 8)
+    np.testing.assert_array_equal(np.asarray(S.array), a[4:8, :])
+    S.set_array(jnp.zeros((4, 8), dtype=jnp.float64))
+    np.testing.assert_array_equal(np.asarray(A.array)[4:8, :], 0)
+    np.testing.assert_array_equal(np.asarray(A.array)[:4, :], a[:4, :])
+    L = A.slice(1, 3, 2, 6)
+    assert L.shape == (3, 5)
+
+
+def test_transpose_is_flag_flip():
+    a = np.arange(12, dtype=np.float64).reshape(3, 4)
+    A = slate.Matrix.from_array(a, nb=2)
+    At = A.T
+    assert At.shape == (4, 3) and At.op == slate.Op.Trans
+    np.testing.assert_array_equal(np.asarray(At.array), a.T)
+    assert At.storage is A.storage
+    # transpose of transpose is identity
+    np.testing.assert_array_equal(np.asarray(At.T.array), a)
+    # sub of a transposed view
+    np.testing.assert_array_equal(np.asarray(At.sub(0, 1, 0, 0).array), a.T[:4, :2])
+
+
+def test_conj_transpose_complex():
+    a = (np.arange(9) + 1j * np.arange(9)).reshape(3, 3).astype(np.complex128)
+    A = slate.Matrix.from_array(a, nb=2)
+    np.testing.assert_array_equal(np.asarray(A.H.array), a.conj().T)
+
+
+def test_hermitian_full_array():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((6, 6)) + 1j * rng.standard_normal((6, 6))
+    H = slate.HermitianMatrix.from_array("lower", a, nb=2)
+    full = np.asarray(H.full_array())
+    np.testing.assert_allclose(full, np.tril(a, -1) + np.tril(a, -1).conj().T
+                               + np.diag(np.real(np.diag(a))))
+    assert np.allclose(full, full.conj().T)
+
+
+def test_symmetric_full_array():
+    a = np.arange(16, dtype=np.float64).reshape(4, 4)
+    S = slate.SymmetricMatrix.from_array("upper", a, nb=2)
+    full = np.asarray(S.full_array())
+    np.testing.assert_array_equal(full, np.triu(a) + np.triu(a, 1).T)
+
+
+def test_triangular_masked():
+    a = np.arange(16, dtype=np.float64).reshape(4, 4) + 1
+    T = slate.TriangularMatrix.from_array("lower", a, nb=2, diag="unit")
+    m = np.asarray(T.masked_array())
+    assert np.all(np.diag(m) == 1)
+    np.testing.assert_array_equal(np.triu(m, 1), 0)
+    np.testing.assert_array_equal(np.tril(m, -1), np.tril(a, -1))
+
+
+def test_band_mask():
+    B = slate.BandMatrix(6, 6, kl=1, ku=2, nb=2, dtype=jnp.float64)
+    mask = np.asarray(B.band_mask())
+    assert mask[0, 2] and not mask[0, 3]
+    assert mask[2, 1] and not mask[3, 1]
+
+
+def test_tile_rank_block_cyclic():
+    A = slate.Matrix(16, 16, nb=4, p=2, q=2)
+    # col-major 2x2 grid: tile (i,j) -> (i%2) + (j%2)*2
+    assert A.tileRank(0, 0) == 0 and A.tileRank(1, 0) == 1
+    assert A.tileRank(0, 1) == 2 and A.tileRank(1, 1) == 3
+    assert A.tileRank(2, 2) == 0
+    # transposed view swaps the map (func.hh:229-237)
+    assert A.T.tileRank(0, 1) == 1
+
+
+def test_enums_round_trip():
+    assert slate.Op.from_string("t") == slate.Op.Trans
+    assert slate.Uplo.from_string("Lower") == slate.Uplo.Lower
+    assert slate.Norm.from_string("1") == slate.Norm.One
+    assert str(slate.MethodLU.CALU) == "calu"
+    opts = slate.Options.make({"block_size": 64, "method_lu": "calu"})
+    assert opts.block_size == 64 and opts.method_lu == slate.MethodLU.CALU
+    with pytest.raises(TypeError):
+        slate.Options.make({"no_such_option": 1})
+
+
+def test_band_transpose_swaps_bandwidths():
+    B = slate.BandMatrix(6, 6, kl=1, ku=2, nb=2, dtype=jnp.float64)
+    Bt = B.T
+    assert (Bt.kl, Bt.ku) == (2, 1)
+    mask = np.asarray(Bt.band_mask())
+    assert mask[2, 0] and not mask[0, 3]
+    T = slate.TriangularBandMatrix("lower", 6, 2, 2, dtype=jnp.float64)
+    assert T.T.kd == 2 and T.T.uplo == slate.Uplo.Upper
+
+
+def test_slice_bounds_and_tile_rank_guard():
+    a = np.arange(64, dtype=np.float64).reshape(8, 8)
+    A = slate.Matrix.from_array(a, nb=4, p=2, q=2)
+    with pytest.raises(slate.SlateError):
+        A.slice(0, 100, 0, 3)
+    S = A.slice(2, 6, 0, 7)  # legal, but not tile-aligned
+    with pytest.raises(slate.SlateError):
+        S.tileRank(0, 0)
+
+
+def test_tile_access_on_transposed_view():
+    a = np.arange(24, dtype=np.float64).reshape(4, 6)
+    A = slate.Matrix.from_array(a, nb=2)
+    At = A.T
+    np.testing.assert_array_equal(np.asarray(At.tile(2, 1)), a.T[4:6, 2:4])
+    At.set_tile(2, 1, jnp.zeros((2, 2), dtype=jnp.float64))
+    np.testing.assert_array_equal(np.asarray(A.array)[2:4, 4:6], 0)
