@@ -20,4 +20,8 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
                    TileKind, TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
                    Uplo, func)
 
+from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
+                   scale_row_col, set, symm, syr2k, syrk, trmm, trsm)
+from .linalg import posv, posv_mixed, potrf, potri, potrs, trtri, trtrm
+
 __version__ = "0.1.0"

@@ -1,0 +1,199 @@
+"""Public parallel BLAS-3 and auxiliary drivers — the L5 API.
+
+Reference analogue: the BLAS-3 and Aux rows of the driver inventory (SURVEY.md §2.4):
+``src/{gemm,gemmA,gemmC,hemm,symm,herk,her2k,syrk,syr2k,trmm,trsm}.cc`` and
+``src/{add,copy,scale,scale_row_col,set,norm,colNorms}.cc``, declared in
+``include/slate/slate.hh``.
+
+Drivers accept Matrix wrappers (using their op/uplo/diag flags, like the reference's
+typed-matrix dispatch) or raw arrays with explicit keywords.  Each mutates its output
+wrapper in place (functional rebind) *and* returns the new array, so both the
+reference's in-place style and JAX's functional style work.
+
+Method dispatch: ``select_algo`` mirrors src/gemm.cc:12-24 — on a single device all
+stationary variants lower to the same fused XLA matmul (stationarity is a communication
+concept), so the choice only matters on a distributed mesh where MethodGemm.SUMMA
+routes to the shard_map pipeline (parallel/summa.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.exceptions import SlateError, slate_assert
+from .core.matrix import (BaseBandMatrix, BaseMatrix, BaseTrapezoidMatrix,
+                          HermitianMatrix, SymmetricMatrix, as_array, write_back)
+from .core.types import (Diag, MethodGemm, Norm, NormScope, Options, Side, Uplo)
+from .ops import blas3, elementwise, norms as norm_ops
+
+
+def _uplo_of(A, uplo) -> Uplo:
+    if uplo is not None:
+        return Uplo.from_string(uplo)
+    if isinstance(A, (BaseTrapezoidMatrix, BaseBandMatrix)) and A.uplo != Uplo.General:
+        return A.uplo
+    raise SlateError("uplo required (pass a triangular/symmetric matrix or uplo=...)")
+
+
+def _diag_of(A, diag) -> Diag:
+    if diag is not None:
+        return Diag.from_string(diag)
+    return getattr(A, "diag", Diag.NonUnit)
+
+
+def select_algo_gemm(A, B, C, opts: Options) -> MethodGemm:
+    """Pick a gemm variant (src/gemm.cc:12-24 select_algo).
+
+    The reference picks stationary-C when B has >= 2 block columns, else stationary-A.
+    On one device both are the same XLA matmul; the distinction is kept so distributed
+    callers can follow the same heuristic.
+    """
+    if opts.method_gemm != MethodGemm.Auto:
+        return opts.method_gemm
+    B_nt = B.nt if isinstance(B, BaseMatrix) else 2
+    return MethodGemm.C if B_nt >= 2 else MethodGemm.A
+
+
+def gemm(alpha, A, B, beta, C, opts=None):
+    """C = alpha op(A) op(B) + beta C (src/gemm.cc:87)."""
+    opts = Options.make(opts)
+    method = select_algo_gemm(A, B, C, opts)
+    if method == MethodGemm.SUMMA:
+        # explicit shard_map pipeline; requires distributed wrappers
+        from .parallel import summa
+        out = summa.summa_gemm(alpha, A, B, beta, C, opts)
+    else:
+        # stationary-A/C both lower to one fused MXU matmul on a single array;
+        # stationarity is a communication-layout concept handled by the sharding
+        out = blas3.gemm(alpha, as_array(A), as_array(B), beta, as_array(C))
+    return write_back(C, out)
+
+
+def symm(side, alpha, A, B, beta, C, opts=None, uplo=None):
+    """C = alpha A B + beta C, A symmetric (src/symm.cc)."""
+    out = blas3.symm(side, alpha, as_array(A), _uplo_of(A, uplo),
+                     as_array(B), beta, as_array(C))
+    return write_back(C, out)
+
+
+def hemm(side, alpha, A, B, beta, C, opts=None, uplo=None):
+    """Hermitian symm (src/hemm.cc, hemmA/hemmC variants)."""
+    out = blas3.hemm(side, alpha, as_array(A), _uplo_of(A, uplo),
+                     as_array(B), beta, as_array(C))
+    return write_back(C, out)
+
+
+def syrk(alpha, A, beta, C, opts=None, uplo=None):
+    """C = alpha A A^T + beta C on the stored triangle (src/syrk.cc)."""
+    out = blas3.syrk(alpha, as_array(A), beta, as_array(C), _uplo_of(C, uplo))
+    return write_back(C, out)
+
+
+def herk(alpha, A, beta, C, opts=None, uplo=None):
+    """C = alpha A A^H + beta C, alpha/beta real (src/herk.cc)."""
+    out = blas3.herk(alpha, as_array(A), beta, as_array(C), _uplo_of(C, uplo))
+    return write_back(C, out)
+
+
+def syr2k(alpha, A, B, beta, C, opts=None, uplo=None):
+    out = blas3.syr2k(alpha, as_array(A), as_array(B), beta, as_array(C),
+                      _uplo_of(C, uplo))
+    return write_back(C, out)
+
+
+def her2k(alpha, A, B, beta, C, opts=None, uplo=None):
+    out = blas3.her2k(alpha, as_array(A), as_array(B), beta, as_array(C),
+                      _uplo_of(C, uplo))
+    return write_back(C, out)
+
+
+def trmm(side, alpha, A, B, opts=None, uplo=None, diag=None):
+    """B = alpha op(T) B / alpha B op(T) (src/trmm.cc; work::trmm body)."""
+    out = blas3.trmm(side, _uplo_of(A, uplo), _diag_of(A, diag),
+                     alpha, as_array(A), as_array(B))
+    return write_back(B, out)
+
+
+def trsm(side, alpha, A, B, opts=None, uplo=None, diag=None):
+    """Solve op(T) X = alpha B in place of B (src/trsm.cc; work::trsm,
+    work_trsm.cc:54-387 — the lookahead task DAG collapses into XLA's blocked
+    TriangularSolve on TPU)."""
+    out = blas3.trsm(side, _uplo_of(A, uplo), _diag_of(A, diag),
+                     alpha, as_array(A), as_array(B))
+    return write_back(B, out)
+
+
+# ---------------------------------------------------------------------------
+# Aux drivers (add/copy/scale/set/norm)
+# ---------------------------------------------------------------------------
+
+
+def add(alpha, A, beta, B, opts=None):
+    """B = alpha A + beta B (src/add.cc; tzadd for trapezoid operands)."""
+    if isinstance(B, BaseTrapezoidMatrix):
+        out = elementwise.tzadd(B.uplo, alpha, as_array(A), beta, as_array(B))
+    else:
+        out = elementwise.geadd(alpha, as_array(A), beta, as_array(B))
+    return write_back(B, out)
+
+
+def copy(A, B, opts=None):
+    """B = A with dtype conversion (src/copy.cc; device_gecopy.cu)."""
+    if isinstance(B, BaseTrapezoidMatrix):
+        out = elementwise.tzcopy(B.uplo, as_array(A), as_array(B))
+    else:
+        out = elementwise.gecopy(as_array(A), as_array(B).dtype)
+    return write_back(B, out)
+
+
+def scale(numer, denom, A, opts=None):
+    """A *= numer/denom (src/scale.cc)."""
+    if isinstance(A, BaseTrapezoidMatrix):
+        out = elementwise.tzscale(A.uplo, numer, denom, as_array(A))
+    else:
+        out = elementwise.gescale(numer, denom, as_array(A))
+    return write_back(A, out)
+
+
+def scale_row_col(R, C, A, opts=None):
+    """A = diag(R) A diag(C) equilibration (src/scale_row_col.cc)."""
+    out = elementwise.gescale_row_col(jnp.asarray(R), jnp.asarray(C), as_array(A))
+    return write_back(A, out)
+
+
+def set(offdiag_value, diag_value, A, opts=None):  # noqa: A001 - reference name
+    """Set entries to constants (src/set.cc; geset/tzset kernels)."""
+    if isinstance(A, BaseTrapezoidMatrix):
+        out = elementwise.tzset(A.uplo, offdiag_value, diag_value, as_array(A))
+    else:
+        out = elementwise.geset(offdiag_value, diag_value, as_array(A))
+    return write_back(A, out)
+
+
+def norm(norm_kind, A, opts=None, scope=NormScope.Matrix, uplo=None, diag=None):
+    """Matrix norm dispatched on matrix type (src/norm.cc).
+
+    General -> genorm, symmetric/Hermitian -> synorm/henorm, triangular -> trnorm,
+    band -> gbnorm/hbnorm (internal_*norm.cc family).
+    """
+    a = as_array(A)
+    if isinstance(A, HermitianMatrix):
+        return norm_ops.henorm(norm_kind, A.uplo, a)
+    if isinstance(A, SymmetricMatrix):
+        return norm_ops.synorm(norm_kind, A.uplo, a)
+    if isinstance(A, BaseTrapezoidMatrix):
+        return norm_ops.trnorm(norm_kind, A.uplo, A.diag, a)
+    if isinstance(A, BaseBandMatrix):
+        from .core.matrix import HermitianBandMatrix
+        if isinstance(A, HermitianBandMatrix):
+            return norm_ops.hbnorm(norm_kind, A.uplo, A.kd, a)
+        # TriangularBandMatrix's (kl, ku) already encode triangle ∩ band exactly
+        return norm_ops.gbnorm(norm_kind, A.kl, A.ku, a)
+    return norm_ops.genorm(norm_kind, a, scope)
+
+
+def col_norms(norm_kind, A, opts=None):
+    """Per-column max norms (src/colNorms.cc; Norm.Max only, like the reference)."""
+    return norm_ops.genorm(norm_kind, as_array(A), NormScope.Columns)

@@ -1,0 +1,3 @@
+"""Driver algorithms (reference L4, src/*.cc)."""
+
+from .chol import posv, posv_mixed, potrf, potri, potrs, trtri, trtrm

@@ -1,0 +1,345 @@
+"""Cholesky family: potrf / potrs / posv / trtri / trtrm / potri / posv_mixed.
+
+Reference analogue: ``src/potrf.cc:22-281`` (the canonical lookahead task-DAG driver,
+SURVEY.md §3.1), ``src/{potrs,posv,potri,trtri,trtrm,posv_mixed}.cc`` and the panel
+kernel ``src/internal/internal_potrf.cc``.
+
+TPU re-design of the potrf pipeline:
+
+* The reference runs an OpenMP task DAG: factor diagonal tile -> MPI-bcast panel ->
+  batched trsm -> batched herk trailing update, with lookahead columns prioritized
+  (potrf.cc:84-195).  On TPU the same right-looking blocked recurrence is expressed as
+  a *software-pipelined XLA program*: a Python-unrolled loop over block columns (static
+  shapes per step, every matmul MXU-shaped), with no dynamic task runtime — XLA's async
+  scheduler overlaps the (sharded) panel collectives with the trailing update, which is
+  exactly what the lookahead machinery hand-builds in OpenMP.
+* The panel factor (internal_potrf.cc -> lapack::potrf on one tile) is
+  ``lax.linalg.cholesky`` on the nb x nb diagonal block; the panel trsm is XLA's native
+  blocked TriangularSolve; the trailing herk is one fused matmul per step.
+* ``Target.XLA`` routes the whole factorization to ``lax.linalg.cholesky`` — the
+  analogue of calling the vendor library on a single tile when the matrix fits one
+  device.  ``Target.Tiled`` (default for distributed or when nb is specified) runs the
+  blocked recurrence above; it is the path that honors Options.block_size and shards
+  over a mesh.
+
+Non-SPD detection: the reference reduces an ``info`` code across ranks
+(internal_reduce_info.cc, potrf.cc:208).  Here ``info`` is computed functionally from
+the factor's diagonal (NaN or <= 0 -> first failing global index + 1, LAPACK-style).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.exceptions import SlateError
+from ..core.matrix import BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array, write_back
+from ..core.types import Options, Target, Uplo
+from ..ops import blas3
+from ..utils.trace import trace_block
+
+
+def _full_spd(A, uplo) -> jax.Array:
+    """Materialize the full Hermitian matrix from a half-stored wrapper or array."""
+    if isinstance(A, (HermitianMatrix, SymmetricMatrix)):
+        return A.full_array()
+    a = as_array(A)
+    if uplo is None:
+        return a  # trust caller: already full
+    uplo = Uplo.from_string(uplo)
+    if uplo == Uplo.Lower:
+        strict = jnp.tril(a, -1)
+    else:
+        strict = jnp.triu(a, 1)
+    other = jnp.conj(jnp.swapaxes(strict, -1, -2)) if jnp.iscomplexobj(a) \
+        else jnp.swapaxes(strict, -1, -2)
+    idx = jnp.arange(a.shape[-1])
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    if jnp.iscomplexobj(a):
+        diag = jnp.real(diag).astype(a.dtype)
+    return (strict + other).at[..., idx, idx].set(diag)
+
+
+def _chol_info(L) -> jax.Array:
+    """LAPACK-style info from a lower factor: 0 if SPD, else 1-based index of the
+    first non-positive/NaN pivot (reference reduce_info semantics)."""
+    d = jnp.real(jnp.diagonal(L, axis1=-2, axis2=-1))
+    bad = jnp.isnan(d) | (d <= 0)
+    any_bad = jnp.any(bad)
+    first = jnp.argmax(bad)  # first True (argmax of bool)
+    return jnp.where(any_bad, first + 1, 0).astype(jnp.int32)
+
+
+def _host_chol_info(a, nb: int = 256) -> int:
+    """Exact 1-based first-failing-pivot index, found by a host-side blocked
+    factorization.  Runs only on the (exceptional) non-SPD path, because XLA's
+    Cholesky NaN-fills the whole output and loses the index the reference reports
+    via its per-tile info codes (potrf.cc:208)."""
+    import numpy as np
+
+    a = np.array(a, copy=True)
+    n = a.shape[-1]
+    for k0 in range(0, n, nb):
+        k1 = min(k0 + nb, n)
+        blk = a[k0:k1, k0:k1]
+        try:
+            Lkk = np.linalg.cholesky(blk)
+        except np.linalg.LinAlgError:
+            # scalar scan inside the failing block
+            for j in range(k1 - k0):
+                d = blk[j, j] - np.real(np.dot(blk[j, :j], np.conj(blk[j, :j])))
+                if not (d > 0) or np.isnan(d):
+                    return k0 + j + 1
+                blk[j, j] = np.sqrt(d)
+                if j + 1 < k1 - k0:
+                    blk[j+1:, j] = (blk[j+1:, j]
+                                    - blk[j+1:, :j] @ np.conj(blk[j, :j])) / blk[j, j]
+            return k1  # shouldn't happen
+        if k1 < n:
+            # pan = A21 · Lkk^{-H}  (pan^H = Lkk^{-1} · A21^H)
+            pan = np.linalg.solve(Lkk, a[k1:, k0:k1].conj().T).conj().T
+            a[k1:, k1:] -= pan @ np.conj(pan.T)
+            a[k1:, k0:k1] = pan
+    return 0
+
+
+@lru_cache(maxsize=32)
+def _potrf_tiled_fn(n: int, nb: int, dtype_str: str):
+    """Build + jit the blocked right-looking factorization for static (n, nb)."""
+
+    nt = -(-n // nb)
+
+    def fn(Af):
+        L = Af
+        for k in range(nt):
+            k0, k1 = k * nb, min((k + 1) * nb, n)
+            # panel factor (≅ internal::potrf on the diagonal tile, potrf.cc:96-102)
+            Akk = L[k0:k1, k0:k1]
+            Lkk = lax.linalg.cholesky(Akk)
+            L = L.at[k0:k1, k0:k1].set(Lkk)
+            if k1 < n:
+                # panel trsm (≅ internal::trsm over the panel, potrf.cc:115-119);
+                # the panel "broadcast" (tileBcast, potrf.cc:109) is implicit: XLA
+                # inserts the all-gather when the operands are sharded.
+                panel = lax.linalg.triangular_solve(
+                    Lkk, L[k1:n, k0:k1], left_side=False, lower=True,
+                    conjugate_a=True, transpose_a=True)
+                L = L.at[k1:n, k0:k1].set(panel)
+                # trailing update (≅ internal::herk, potrf.cc:136-148 — the hot loop).
+                # Full-width update keeps the trailing block Hermitian so later panels
+                # read valid data without re-symmetrizing.
+                upd = jnp.matmul(panel, jnp.conj(panel.T),
+                                 precision=lax.Precision.HIGHEST)
+                L = L.at[k1:n, k1:n].add(-upd)
+        return jnp.tril(L)
+
+    return jax.jit(fn)
+
+
+def potrf(A, opts=None, uplo=None):
+    """Cholesky factorization A = L L^H (src/potrf.cc:262-281 dispatch shape).
+
+    Returns ``(L, info)``; writes the factor back into the stored triangle of ``A`` if
+    it is a Matrix wrapper.  ``uplo=Upper`` returns/stores U with A = U^H U.
+    """
+    opts = Options.make(opts)
+    the_uplo = uplo or (A.uplo if isinstance(A, BaseMatrix) and A.uplo != Uplo.General
+                        else Uplo.Lower)
+    the_uplo = Uplo.from_string(the_uplo)
+    Af = _full_spd(A, the_uplo if not isinstance(A, (HermitianMatrix, SymmetricMatrix))
+                   else None)
+    n = Af.shape[-1]
+    target = opts.target
+    if target == Target.Auto:
+        target = Target.XLA  # single fused factorization; Tiled for distributed runs
+
+    with trace_block("potrf", n=n, nb=opts.block_size, target=str(target)):
+        if target == Target.XLA:
+            L = jnp.tril(lax.linalg.cholesky(Af))
+        else:
+            L = _potrf_tiled_fn(n, min(opts.block_size, n), str(Af.dtype))(Af)
+    info = _chol_info(L)
+    if int(info) != 0:
+        info = jnp.int32(_host_chol_info(Af))
+
+    out = L if the_uplo == Uplo.Lower else jnp.conj(L.T)
+    if isinstance(A, BaseMatrix):
+        # store only into the stored triangle, leave the rest untouched
+        stored = as_array(A)
+        mask = jnp.tril(jnp.ones_like(stored, dtype=bool)) if the_uplo == Uplo.Lower \
+            else jnp.triu(jnp.ones_like(stored, dtype=bool))
+        write_back(A, jnp.where(mask, out, stored))
+    return out, info
+
+
+def potrs(A, B, opts=None, uplo=None):
+    """Solve A X = B given the Cholesky factor (src/potrs.cc: two work::trsm calls)."""
+    opts = Options.make(opts)
+    the_uplo = Uplo.from_string(uplo or (A.uplo if isinstance(A, BaseMatrix)
+                                         and A.uplo != Uplo.General else Uplo.Lower))
+    F = as_array(A)
+    L = jnp.tril(F) if the_uplo == Uplo.Lower else jnp.conj(jnp.triu(F).T)
+    b = as_array(B)
+    with trace_block("potrs"):
+        y = lax.linalg.triangular_solve(L, b, left_side=True, lower=True)
+        x = lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                        conjugate_a=True, transpose_a=True)
+    return write_back(B, x)
+
+
+def posv(A, B, opts=None, uplo=None):
+    """Solve SPD system A X = B (src/posv.cc = potrf + potrs)."""
+    L, info = potrf(A, opts, uplo)
+    X = potrs(L if not isinstance(A, BaseMatrix) else A, B, opts,
+              uplo=uplo or (A.uplo if isinstance(A, BaseMatrix)
+                            and A.uplo != Uplo.General else "lower"))
+    return X, info
+
+
+def trtri(A, opts=None, uplo=None, diag=None):
+    """Triangular inverse (src/trtri.cc).
+
+    The reference runs a blocked in-place algorithm; on TPU a TriangularSolve against
+    the identity is the same blocked computation executed by one fused XLA op.
+    """
+    from ..blas import _diag_of  # local import to avoid cycle
+    the_uplo = _default_uplo(A, uplo)
+    the_diag = _diag_of(A, diag)
+    a = as_array(A)
+    n = a.shape[-1]
+    eye = jnp.eye(n, dtype=a.dtype)
+    with trace_block("trtri", n=n):
+        inv = lax.linalg.triangular_solve(
+            a, eye, left_side=True, lower=(the_uplo == Uplo.Lower),
+            unit_diagonal=(the_diag.value == "unit"))
+    tri = jnp.tril if the_uplo == Uplo.Lower else jnp.triu
+    return _write_triangle(A, tri(inv), the_uplo)
+
+
+def trtrm(A, opts=None, uplo=None):
+    """Triangular-triangular multiply L^H L (or U U^H) producing a Hermitian result in
+    the stored triangle — the second half of potri (src/trtrm.cc)."""
+    the_uplo = _default_uplo(A, uplo)
+    a = as_array(A)
+    if the_uplo == Uplo.Lower:
+        L = jnp.tril(a)
+        out = jnp.matmul(jnp.conj(L.T), L, precision=lax.Precision.HIGHEST)
+        res = jnp.tril(out)
+    else:
+        U = jnp.triu(a)
+        out = jnp.matmul(U, jnp.conj(U.T), precision=lax.Precision.HIGHEST)
+        res = jnp.triu(out)
+    return _write_triangle(A, res, the_uplo)
+
+
+def _default_uplo(A, uplo) -> Uplo:
+    """Resolve uplo like the sibling drivers: wrapper flag, else Lower."""
+    return Uplo.from_string(uplo or (A.uplo if isinstance(A, BaseMatrix)
+                                     and A.uplo != Uplo.General else Uplo.Lower))
+
+
+def _write_triangle(A, tri_result, uplo: Uplo):
+    """Write a triangular result into only the stored triangle of a wrapper,
+    preserving the unstored triangle (matches potrf's write-back discipline)."""
+    if not isinstance(A, BaseMatrix):
+        return tri_result
+    stored = as_array(A)
+    mask = jnp.tril(jnp.ones_like(stored, dtype=bool)) if uplo == Uplo.Lower \
+        else jnp.triu(jnp.ones_like(stored, dtype=bool))
+    write_back(A, jnp.where(mask, tri_result, stored))
+    return tri_result
+
+
+def potri(A, opts=None, uplo=None):
+    """SPD inverse from the Cholesky factor: A^{-1} = L^{-H} L^{-1}
+    (src/potri.cc = trtri + trtrm)."""
+    the_uplo = _default_uplo(A, uplo)
+    Linv = trtri(A, opts, uplo=the_uplo, diag="nonunit")
+    return trtrm(A if isinstance(A, BaseMatrix) else Linv, opts, uplo=the_uplo)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision iterative refinement (src/posv_mixed.cc, gesv_mixed.cc:23-40)
+# ---------------------------------------------------------------------------
+
+
+def _lower_precision(dtype):
+    """The reference factors f64 systems in f32 (gesv_mixed). TPU ladder:
+    f64->f32, f32->bf16, c128->c64."""
+    mapping = {
+        jnp.dtype(jnp.float64): jnp.float32,
+        jnp.dtype(jnp.float32): jnp.bfloat16,
+        jnp.dtype(jnp.complex128): jnp.complex64,
+    }
+    return mapping.get(jnp.dtype(dtype))
+
+
+def _ir_solve(Af, b, solve_lo, opts: Options):
+    """Generic iterative-refinement loop shared by posv_mixed/gesv_mixed
+    (gesv_mixed.cc iterative loop): solve in low precision, refine the residual in
+    working precision, stop on ||r|| <= ||x|| * ||A|| * sqrt(n) * eps."""
+    n = Af.shape[-1]
+    eps = jnp.finfo(Af.dtype).eps if jnp.issubdtype(Af.dtype, jnp.floating) else \
+        jnp.finfo(jnp.float64 if Af.dtype == jnp.complex128 else jnp.float32).eps
+    tol = opts.tolerance if opts.tolerance is not None else float(eps) * (n ** 0.5)
+    anorm = jnp.max(jnp.sum(jnp.abs(Af), axis=-1))  # inf-norm
+
+    x0 = solve_lo(b).astype(b.dtype)
+
+    def cond(state):
+        x, it, converged = state
+        return (~converged) & (it < opts.max_iterations)
+
+    def body(state):
+        x, it, _ = state
+        r = b - jnp.matmul(Af, x, precision=lax.Precision.HIGHEST)
+        dx = solve_lo(r).astype(b.dtype)
+        x = x + dx
+        rnorm = jnp.max(jnp.abs(b - jnp.matmul(Af, x, precision=lax.Precision.HIGHEST)))
+        xnorm = jnp.max(jnp.abs(x))
+        converged = rnorm <= tol * anorm * xnorm
+        return x, it + 1, converged
+
+    r0 = b - jnp.matmul(Af, x0, precision=lax.Precision.HIGHEST)
+    conv0 = jnp.max(jnp.abs(r0)) <= tol * anorm * jnp.max(jnp.abs(x0))
+    x, iters, converged = lax.while_loop(cond, body, (x0, jnp.int32(0), conv0))
+    return x, iters, converged
+
+
+def posv_mixed(A, B, opts=None, uplo=None):
+    """SPD solve: low-precision factor + working-precision refinement
+    (src/posv_mixed.cc; falls back to full-precision posv when IR stalls,
+    Option::UseFallbackSolver, gesv_mixed.cc:93-96).
+
+    Returns (X, info, iters).
+    """
+    opts = Options.make(opts)
+    the_uplo = uplo or (A.uplo if isinstance(A, BaseMatrix) and A.uplo != Uplo.General
+                        else Uplo.Lower)
+    Af = _full_spd(A, None if isinstance(A, (HermitianMatrix, SymmetricMatrix))
+                   else the_uplo)
+    b = as_array(B)
+    lo = opts.factor_precision or _lower_precision(Af.dtype)
+    if lo is None:
+        X, info = posv(A, B, opts, uplo)
+        return X, info, jnp.int32(0)
+
+    with trace_block("posv_mixed", lo=str(lo)):
+        L_lo = lax.linalg.cholesky(Af.astype(lo))
+        info = _chol_info(L_lo)
+
+        def solve_lo(rhs):
+            y = lax.linalg.triangular_solve(L_lo, rhs.astype(lo), left_side=True,
+                                            lower=True)
+            return lax.linalg.triangular_solve(L_lo, y, left_side=True, lower=True,
+                                               conjugate_a=True, transpose_a=True)
+
+        x, iters, converged = _ir_solve(Af, b, solve_lo, opts)
+
+    if opts.use_fallback_solver and not bool(converged):
+        X, info = posv(A, B, opts, uplo)   # full-precision fallback
+        return X, info, iters
+    return write_back(B, x), info, iters

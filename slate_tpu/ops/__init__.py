@@ -1,0 +1,3 @@
+"""Internal compute kernels (reference L3, src/internal/) as pure XLA functions."""
+
+from . import blas3, elementwise, norms

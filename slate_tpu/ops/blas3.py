@@ -1,0 +1,151 @@
+"""Pure-array BLAS-3 kernels — the L3 "internal" layer.
+
+Reference analogue: ``src/internal/internal_{gemm,hemm,herk,her2k,symm,syrk,syr2k,trmm,
+trsm}.cc`` (one parallel step per op, specialized per Target) and the per-tile BLAS in
+``include/slate/Tile_blas.hh``.
+
+TPU re-design: the reference decomposes each op into per-tile batched vendor-BLAS calls
+grouped by ``device_regions_build`` (internal_batch.hh:198-391).  On TPU the *whole
+operand* is one HBM-resident array and XLA tiles the matmul onto the MXU itself, so the
+"internal" layer collapses to single fused XLA ops: ``jnp.matmul`` drives the MXU
+directly, ``lax.linalg.triangular_solve`` is the native blocked TRSM, and masking
+(tril/triu) expresses the triangular/symmetric structure that the reference encodes in
+its typed tile loops.  The tiled/distributed decompositions live one level up
+(slate_tpu/blas.py drivers and slate_tpu/parallel/ for the SUMMA pipeline).
+
+All functions are pure (array in, array out) and jit-friendly; structure flags are
+static Python values so XLA sees a fixed program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.types import Diag, Side, Uplo
+
+
+def _c(alpha, ref):
+    """Cast a scalar to the result dtype."""
+    return jnp.asarray(alpha, dtype=ref.dtype)
+
+
+def gemm(alpha, A: jax.Array, B: jax.Array, beta, C: jax.Array) -> jax.Array:
+    """C = alpha A B + beta C (internal_gemm.cc; MXU-native via jnp.matmul)."""
+    ab = jnp.matmul(A, B, precision=lax.Precision.HIGHEST)
+    return _c(alpha, ab) * ab + _c(beta, C) * C
+
+
+def _symmetrize(A, uplo: Uplo, conj: bool):
+    uplo = Uplo.from_string(uplo)
+    if uplo == Uplo.Lower:
+        strict = jnp.tril(A, -1)
+    else:
+        strict = jnp.triu(A, 1)
+    other = jnp.swapaxes(strict, -1, -2)
+    if conj and jnp.iscomplexobj(A):
+        other = jnp.conj(other)
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    if conj and jnp.iscomplexobj(A):
+        diag = jnp.real(diag).astype(A.dtype)
+    n = A.shape[-1]
+    idx = jnp.arange(n)
+    return (strict + other).at[..., idx, idx].set(diag)
+
+
+def symm(side, alpha, A, uplo, B, beta, C):
+    """C = alpha A B + beta C with A symmetric stored in `uplo` (internal_symm)."""
+    Af = _symmetrize(A, uplo, conj=False)
+    side = Side.from_string(side)
+    prod = jnp.matmul(Af, B) if side == Side.Left else jnp.matmul(B, Af)
+    return _c(alpha, prod) * prod + _c(beta, C) * C
+
+
+def hemm(side, alpha, A, uplo, B, beta, C):
+    """Hermitian counterpart of symm (internal_hemm)."""
+    Af = _symmetrize(A, uplo, conj=True)
+    side = Side.from_string(side)
+    prod = jnp.matmul(Af, B) if side == Side.Left else jnp.matmul(B, Af)
+    return _c(alpha, prod) * prod + _c(beta, C) * C
+
+
+def _rank_k_update(update, beta, C, uplo: Uplo, real_diag: bool):
+    """Apply a rank-k update to the stored triangle only, leaving the other triangle of
+    the backing array untouched (the reference updates only local tiles of the stored
+    triangle)."""
+    uplo = Uplo.from_string(uplo)
+    n = C.shape[-1]
+    r = jnp.arange(n)
+    mask = (r[:, None] >= r[None, :]) if uplo == Uplo.Lower else (r[:, None] <= r[None, :])
+    new = update + _c(beta, C) * C
+    if real_diag and jnp.iscomplexobj(new):
+        idx = jnp.arange(n)
+        new = new.at[..., idx, idx].set(
+            jnp.real(jnp.diagonal(new, axis1=-2, axis2=-1)).astype(new.dtype))
+    return jnp.where(mask, new, C)
+
+
+def syrk(alpha, A, beta, C, uplo):
+    """C(uplo) = alpha A A^T + beta C (internal_syrk)."""
+    up = jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+    return _rank_k_update(_c(alpha, up) * up, beta, C, uplo, real_diag=False)
+
+
+def herk(alpha, A, beta, C, uplo):
+    """C(uplo) = alpha A A^H + beta C, alpha/beta real (internal_herk) — the hot op of
+    the Cholesky trailing update (potrf.cc:136-148)."""
+    up = jnp.matmul(A, jnp.conj(jnp.swapaxes(A, -1, -2)))
+    return _rank_k_update(_c(alpha, up) * up, beta, C, uplo, real_diag=True)
+
+
+def syr2k(alpha, A, B, beta, C, uplo):
+    up = jnp.matmul(A, jnp.swapaxes(B, -1, -2))
+    up = _c(alpha, up) * up + _c(alpha, up) * jnp.matmul(B, jnp.swapaxes(A, -1, -2))
+    return _rank_k_update(up, beta, C, uplo, real_diag=False)
+
+
+def her2k(alpha, A, B, beta, C, uplo):
+    up1 = jnp.matmul(A, jnp.conj(jnp.swapaxes(B, -1, -2)))
+    up2 = jnp.matmul(B, jnp.conj(jnp.swapaxes(A, -1, -2)))
+    up = _c(alpha, up1) * up1 + jnp.conj(_c(alpha, up1)) * up2
+    return _rank_k_update(up, beta, C, uplo, real_diag=True)
+
+
+def _triangle(A, uplo: Uplo, diag: Diag):
+    uplo = Uplo.from_string(uplo)
+    diag = Diag.from_string(diag)
+    T = jnp.tril(A) if uplo == Uplo.Lower else jnp.triu(A)
+    if diag == Diag.Unit:
+        n = A.shape[-1]
+        idx = jnp.arange(n)
+        T = T.at[..., idx, idx].set(jnp.ones((), dtype=A.dtype))
+    return T
+
+
+def trmm(side, uplo, diag, alpha, A, B):
+    """B = alpha op(T) B or alpha B op(T), T triangular (internal_trmm)."""
+    T = _triangle(A, uplo, diag)
+    side = Side.from_string(side)
+    prod = jnp.matmul(T, B) if side == Side.Left else jnp.matmul(B, T)
+    return _c(alpha, prod) * prod
+
+
+def trsm(side, uplo, diag, alpha, A, B):
+    """Solve op(T) X = alpha B (Left) or X op(T) = alpha B (Right).
+
+    Reference: internal_trsm.cc -> blas::batch::trsm.  TPU-native: XLA's
+    TriangularSolve is itself a blocked MXU algorithm, so one lax call replaces the
+    tile loop."""
+    side = Side.from_string(side)
+    uplo = Uplo.from_string(uplo)
+    diag = Diag.from_string(diag)
+    X = lax.linalg.triangular_solve(
+        A, _c(alpha, B) * B,
+        left_side=(side == Side.Left),
+        lower=(uplo == Uplo.Lower),
+        unit_diagonal=(diag == Diag.Unit),
+        transpose_a=False, conjugate_a=False)
+    return X

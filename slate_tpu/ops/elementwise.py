@@ -1,0 +1,79 @@
+"""Elementwise / copy / set kernels.
+
+Reference analogue: the device kernels in ``src/cuda/device_{geadd,gecopy,gescale,
+gescale_row_col,geset,tzadd,tzcopy,tzscale,tzset}.cu`` and their internal wrappers
+(``src/internal/internal_{geadd,gecopy,...}.cc``).
+
+TPU re-design: every one of these is a fused XLA elementwise op; the trapezoid (tz*)
+variants become tril/triu masks.  No Pallas needed — XLA fuses these into neighboring
+matmuls, which is precisely why the reference needed hand-written CUDA and we don't.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import Uplo
+
+
+def _mask(shape, uplo: Uplo, dtype=jnp.bool_):
+    """Trapezoid mask including the diagonal."""
+    m, n = shape[-2], shape[-1]
+    r = jnp.arange(m)[:, None]
+    c = jnp.arange(n)[None, :]
+    if Uplo.from_string(uplo) == Uplo.Lower:
+        return r >= c
+    return r <= c
+
+
+def geadd(alpha, A, beta, B):
+    """B = alpha A + beta B (device_geadd.cu)."""
+    a = jnp.asarray(alpha, B.dtype)
+    b = jnp.asarray(beta, B.dtype)
+    return a * A + b * B
+
+
+def tzadd(uplo, alpha, A, beta, B):
+    """Trapezoid add: only the `uplo` triangle is updated (device_tzadd.cu)."""
+    return jnp.where(_mask(B.shape, uplo), geadd(alpha, A, beta, B), B)
+
+
+def gecopy(A, out_dtype=None):
+    """Copy with optional precision conversion (device_gecopy.cu — used by the
+    mixed-precision solvers to round f64->f32; here any dtype pair)."""
+    return A.astype(out_dtype) if out_dtype is not None else A
+
+
+def tzcopy(uplo, A, B, out_dtype=None):
+    """Copy the `uplo` trapezoid of A over B (device_tzcopy.cu)."""
+    src = gecopy(A, out_dtype or B.dtype)
+    return jnp.where(_mask(B.shape, uplo), src, B)
+
+
+def gescale(numer, denom, A):
+    """A *= numer/denom (device_gescale.cu; two-scalar form avoids overflow)."""
+    s = jnp.asarray(numer, A.dtype) / jnp.asarray(denom, A.dtype)
+    return A * s
+
+
+def tzscale(uplo, numer, denom, A):
+    return jnp.where(_mask(A.shape, uplo), gescale(numer, denom, A), A)
+
+
+def gescale_row_col(R, C, A):
+    """A = diag(R) A diag(C) — row/col equilibration (device_gescale_row_col.cu)."""
+    return A * R[..., :, None] * C[..., None, :]
+
+
+def geset(offdiag_value, diag_value, A):
+    """Set off-diagonal and diagonal entries to constants (device_geset.cu)."""
+    m, n = A.shape[-2], A.shape[-1]
+    out = jnp.full_like(A, offdiag_value)
+    idx = jnp.arange(min(m, n))
+    return out.at[..., idx, idx].set(jnp.asarray(diag_value, A.dtype))
+
+
+def tzset(uplo, offdiag_value, diag_value, A):
+    """Set the `uplo` trapezoid (device_tzset.cu); the other triangle is untouched."""
+    return jnp.where(_mask(A.shape, uplo), geset(offdiag_value, diag_value, A), A)

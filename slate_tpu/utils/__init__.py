@@ -1,0 +1,3 @@
+"""Support subsystems: tracing, debug, printing (reference §2.7)."""
+
+from . import trace

@@ -1,0 +1,109 @@
+"""Named-region tracing and phase timers.
+
+Reference analogue: ``slate::trace`` (src/auxiliary/Trace.cc, 644 LoC) — RAII
+``trace::Block`` regions gathered over MPI into a self-contained SVG timeline — plus
+the per-driver ``timers[]`` phase map surfaced by the tester at --timer-level 2
+(src/heev.cc:126-212).
+
+TPU re-design: the device-side timeline comes for free from ``jax.profiler`` (XLA
+emits a perfetto trace), so this module provides the *host-side* named-region API:
+
+- ``trace_block(name, **attrs)`` context manager ≅ ``trace::Block``; nests.
+- When enabled (``trace.on()``), events are recorded and can be dumped as a
+  chrome://tracing JSON (``trace.finish(path)``) — the portable successor of the
+  reference's SVG writer — and mirrored into ``jax.profiler.TraceAnnotation`` so host
+  regions line up with XLA device slices in one profile.
+- ``Timers`` accumulates named phase durations (the drivers' ``timers[]`` map).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+try:  # TraceAnnotation shows host regions inside XLA profiles
+    from jax.profiler import TraceAnnotation as _JaxAnnotation
+except Exception:  # pragma: no cover
+    _JaxAnnotation = None
+
+_state = threading.local()
+_enabled = False
+_events: List[Dict[str, Any]] = []
+_events_lock = threading.Lock()
+_t0 = time.perf_counter()
+
+
+def on() -> None:
+    """Enable tracing (reference trace::Trace::on())."""
+    global _enabled
+    _enabled = True
+
+
+def off() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_on() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def trace_block(name: str, **attrs):
+    """RAII-style named region (reference trace::Block, internal/Trace.hh:103-108)."""
+    if not _enabled:
+        if _JaxAnnotation is not None and os.environ.get("SLATE_TPU_JAX_TRACE"):
+            with _JaxAnnotation(name):
+                yield
+        else:
+            yield
+        return
+    start = time.perf_counter()
+    try:
+        if _JaxAnnotation is not None:
+            with _JaxAnnotation(name):
+                yield
+        else:
+            yield
+    finally:
+        end = time.perf_counter()
+        ev = {
+            "name": name, "ph": "X", "cat": "slate",
+            "ts": (start - _t0) * 1e6, "dur": (end - start) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident() % 2**31,
+        }
+        if attrs:
+            ev["args"] = {k: str(v) for k, v in attrs.items()}
+        with _events_lock:
+            _events.append(ev)
+
+
+def finish(path: Optional[str] = None) -> Optional[str]:
+    """Write accumulated events as chrome://tracing JSON (reference
+    Trace::finish writes trace_<time>.svg, Trace.cc:330-448). Returns the path."""
+    global _events
+    if not _events:
+        return None
+    path = path or f"trace_{int(time.time())}.json"
+    with _events_lock:
+        payload = {"traceEvents": _events, "displayTimeUnit": "ms"}
+        _events = []
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+class Timers(dict):
+    """Named phase-duration accumulator (drivers' timers[] map, heev.cc:126-212)."""
+
+    @contextlib.contextmanager
+    def time(self, key: str):
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self[key] = self.get(key, 0.0) + (time.perf_counter() - t)

@@ -1,0 +1,211 @@
+"""BLAS-3 + aux driver tests.
+
+Reference model: test/test_gemm.cc residual check ||C_computed - C_ref|| / ||C_ref||
+<= 3*eps (test_gemm.cc:192-207) and unit_test/test_internal_blas.cc (internal ops vs
+reference loops). Here the reference implementation is numpy on small matrices.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import blas
+
+
+def _rand(rng, *shape, cplx=False):
+    a = rng.standard_normal(shape)
+    if cplx:
+        a = a + 1j * rng.standard_normal(shape)
+    return a
+
+
+@pytest.mark.parametrize("opA", ["n", "t"])
+@pytest.mark.parametrize("opB", ["n", "t"])
+def test_gemm_ops(rng, opA, opB):
+    m, n, k = 13, 9, 7
+    a = _rand(rng, *( (m, k) if opA == "n" else (k, m) ))
+    b = _rand(rng, *( (k, n) if opB == "n" else (n, k) ))
+    c = _rand(rng, m, n)
+    A = slate.Matrix.from_array(a, nb=4)
+    B = slate.Matrix.from_array(b, nb=4)
+    C = slate.Matrix.from_array(c.copy(), nb=4)
+    Av = A if opA == "n" else A.T
+    Bv = B if opB == "n" else B.T
+    blas.gemm(2.0, Av, Bv, -1.0, C)
+    ref = 2.0 * (a if opA == "n" else a.T) @ (b if opB == "n" else b.T) - c
+    np.testing.assert_allclose(np.asarray(C.array), ref, rtol=1e-12, atol=1e-12)
+
+
+def test_gemm_conj_trans(rng):
+    a = _rand(rng, 5, 8, cplx=True)
+    b = _rand(rng, 5, 6, cplx=True)
+    c = np.zeros((8, 6), dtype=complex)
+    A = slate.Matrix.from_array(a, nb=3)
+    C = slate.Matrix.from_array(c, nb=3)
+    blas.gemm(1.0, A.H, slate.Matrix.from_array(b, nb=3), 0.0, C)
+    np.testing.assert_allclose(np.asarray(C.array), a.conj().T @ b, rtol=1e-12)
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("uplo", ["lower", "upper"])
+def test_symm_hemm(rng, side, uplo):
+    n, m = 8, 8
+    a = _rand(rng, n, n, cplx=True)
+    a = a + a.conj().T  # hermitian
+    np.fill_diagonal(a, np.real(np.diag(a)))
+    b = _rand(rng, m, n, cplx=True)
+    c = _rand(rng, m, n, cplx=True)
+    A = slate.HermitianMatrix.from_array(uplo, a, nb=3)
+    C = slate.Matrix.from_array(c.copy(), nb=3)
+    blas.hemm(side, 1.5, A, slate.Matrix.from_array(b, nb=3), 0.5, C)
+    ref = 1.5 * (a @ b if side == "left" else b @ a) + 0.5 * c
+    np.testing.assert_allclose(np.asarray(C.array), ref, rtol=1e-12)
+    # symm with real symmetric data
+    sa = np.real(a)
+    S = slate.SymmetricMatrix.from_array(uplo, sa, nb=3)
+    C2 = slate.Matrix.from_array(np.real(c).copy(), nb=3)
+    blas.symm(side, 2.0, S, slate.Matrix.from_array(np.real(b), nb=3), 0.0, C2)
+    ref2 = 2.0 * (sa @ np.real(b) if side == "left" else np.real(b) @ sa)
+    np.testing.assert_allclose(np.asarray(C2.array), ref2, rtol=1e-12)
+
+
+@pytest.mark.parametrize("uplo", ["lower", "upper"])
+def test_herk_updates_stored_triangle_only(rng, uplo):
+    n, k = 9, 5
+    a = _rand(rng, n, k, cplx=True)
+    c0 = _rand(rng, n, n, cplx=True)
+    C = slate.HermitianMatrix.from_array(uplo, c0.copy(), nb=4)
+    blas.herk(1.0, slate.Matrix.from_array(a, nb=4), 2.0, C)
+    got = np.asarray(C.array)
+    ref = a @ a.conj().T + 2.0 * c0
+    tri = np.tril if uplo == "lower" else np.triu
+    anti = np.triu if uplo == "lower" else np.tril
+    np.testing.assert_allclose(tri(got, -1 if uplo == "lower" else 1),
+                               tri(ref, -1 if uplo == "lower" else 1), rtol=1e-12)
+    # diagonal forced real
+    np.testing.assert_allclose(np.diag(got), np.real(np.diag(ref)), rtol=1e-12)
+    # other triangle untouched
+    np.testing.assert_array_equal(anti(got, 1 if uplo == "lower" else -1),
+                                  anti(c0, 1 if uplo == "lower" else -1))
+
+
+def test_syrk_syr2k_her2k(rng):
+    n, k = 7, 4
+    a, b = _rand(rng, n, k), _rand(rng, n, k)
+    c0 = _rand(rng, n, n)
+    C = slate.SymmetricMatrix.from_array("lower", c0.copy(), nb=3)
+    blas.syrk(1.0, slate.Matrix.from_array(a, nb=3), 0.0, C)
+    np.testing.assert_allclose(np.tril(np.asarray(C.array)), np.tril(a @ a.T), rtol=1e-12)
+    C = slate.SymmetricMatrix.from_array("lower", c0.copy(), nb=3)
+    blas.syr2k(1.0, slate.Matrix.from_array(a, nb=3), slate.Matrix.from_array(b, nb=3), 0.0, C)
+    np.testing.assert_allclose(np.tril(np.asarray(C.array)),
+                               np.tril(a @ b.T + b @ a.T), rtol=1e-12)
+    za, zb = _rand(rng, n, k, cplx=True), _rand(rng, n, k, cplx=True)
+    C = slate.HermitianMatrix.from_array("upper", np.zeros((n, n), complex), nb=3)
+    blas.her2k(1.0 + 0.5j, slate.Matrix.from_array(za, nb=3),
+               slate.Matrix.from_array(zb, nb=3), 0.0, C)
+    alpha = 1.0 + 0.5j
+    ref = alpha * za @ zb.conj().T + np.conj(alpha) * zb @ za.conj().T
+    np.testing.assert_allclose(np.triu(np.asarray(C.array)), np.triu(ref), rtol=1e-12)
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("uplo", ["lower", "upper"])
+@pytest.mark.parametrize("diag", ["nonunit", "unit"])
+def test_trsm_trmm_roundtrip(rng, side, uplo, diag):
+    n, m = 8, 6
+    t = _rand(rng, n, n) + n * np.eye(n)
+    b = _rand(rng, *( (n, m) if side == "left" else (m, n) ))
+    T = slate.TriangularMatrix.from_array(uplo, t, nb=3, diag=diag)
+    B = slate.Matrix.from_array(b.copy(), nb=3)
+    blas.trsm(side, 1.0, T, B)
+    X = np.asarray(B.array)
+    tm = np.asarray(T.masked_array())
+    ref = tm @ X if side == "left" else X @ tm
+    np.testing.assert_allclose(ref, b, rtol=1e-9, atol=1e-9)
+    # trmm undoes trsm
+    blas.trmm(side, 1.0, T, B)
+    np.testing.assert_allclose(np.asarray(B.array), b, rtol=1e-9, atol=1e-9)
+
+
+def test_add_copy_scale_set(rng):
+    a, b = _rand(rng, 5, 5), _rand(rng, 5, 5)
+    B = slate.Matrix.from_array(b.copy(), nb=2)
+    blas.add(2.0, slate.Matrix.from_array(a, nb=2), 3.0, B)
+    np.testing.assert_allclose(np.asarray(B.array), 2 * a + 3 * b, rtol=1e-12)
+    # trapezoid add touches only stored triangle
+    L = slate.TriangularMatrix.from_array("lower", b.copy(), nb=2)
+    blas.add(1.0, slate.TriangularMatrix.from_array("lower", a, nb=2), 0.0, L)
+    got = np.asarray(L.array)
+    np.testing.assert_allclose(np.tril(got), np.tril(a), rtol=1e-12)
+    np.testing.assert_array_equal(np.triu(got, 1), np.triu(b, 1))
+    A = slate.Matrix.from_array(a.copy(), nb=2)
+    blas.scale(3.0, 2.0, A)
+    np.testing.assert_allclose(np.asarray(A.array), a * 1.5, rtol=1e-12)
+    blas.set(0.0, 1.0, A)
+    np.testing.assert_array_equal(np.asarray(A.array), np.eye(5))
+    r, c = np.arange(1, 6.0), np.arange(2, 7.0)
+    A = slate.Matrix.from_array(a.copy(), nb=2)
+    blas.scale_row_col(r, c, A)
+    np.testing.assert_allclose(np.asarray(A.array), np.diag(r) @ a @ np.diag(c), rtol=1e-12)
+
+
+def test_copy_precision_convert(rng):
+    a = _rand(rng, 6, 6)
+    A = slate.Matrix.from_array(a, nb=2)
+    B = slate.Matrix(6, 6, nb=2, dtype=jnp.float32)
+    blas.copy(A, B)
+    assert B.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(B.array), a.astype(np.float32), rtol=1e-6)
+
+
+def test_norms_general(rng):
+    a = _rand(rng, 7, 5)
+    A = slate.Matrix.from_array(a, nb=3)
+    assert np.isclose(float(blas.norm("max", A)), np.abs(a).max())
+    assert np.isclose(float(blas.norm("one", A)), np.abs(a).sum(0).max())
+    assert np.isclose(float(blas.norm("inf", A)), np.abs(a).sum(1).max())
+    assert np.isclose(float(blas.norm("fro", A)), np.linalg.norm(a, "fro"))
+    np.testing.assert_allclose(np.asarray(blas.col_norms("max", A)), np.abs(a).max(0))
+
+
+@pytest.mark.parametrize("uplo", ["lower", "upper"])
+def test_norms_symmetric_uses_half_storage(rng, uplo):
+    n = 8
+    full = _rand(rng, n, n)
+    full = full + full.T
+    # poison the unstored triangle: results must not change
+    stored = np.tril(full) if uplo == "lower" else np.triu(full)
+    poison = stored + (np.triu(np.full((n, n), 99.0), 1) if uplo == "lower"
+                       else np.tril(np.full((n, n), 99.0), -1))
+    S = slate.SymmetricMatrix.from_array(uplo, poison, nb=3)
+    assert np.isclose(float(blas.norm("one", S)), np.abs(full).sum(0).max())
+    assert np.isclose(float(blas.norm("max", S)), np.abs(stored).max())
+    assert np.isclose(float(blas.norm("fro", S)), np.linalg.norm(full, "fro"))
+
+
+def test_norms_triangular_band(rng):
+    n = 6
+    a = _rand(rng, n, n)
+    T = slate.TriangularMatrix.from_array("upper", a, nb=2)
+    assert np.isclose(float(blas.norm("fro", T)), np.linalg.norm(np.triu(a), "fro"))
+    B = slate.BandMatrix(n, n, kl=1, ku=1, nb=2, dtype=jnp.float64)
+    B.set_array(a)
+    band = np.tril(np.triu(a, -1), 1)
+    assert np.isclose(float(blas.norm("one", B)), np.abs(band).sum(0).max())
+
+
+def test_triangular_band_norm_not_symmetrized(rng):
+    n = 5
+    a = np.arange(1.0, 26.0).reshape(n, n)
+    T = slate.TriangularBandMatrix("lower", n, kd=1, nb=2, dtype=jnp.float64)
+    T.set_array(a)
+    band = np.tril(np.triu(a, -1), 0)  # lower band kd=1 incl diag
+    assert np.isclose(float(blas.norm("one", T)), np.abs(band).sum(0).max())
+    assert np.isclose(float(blas.norm("fro", T)), np.linalg.norm(band, "fro"))
+
+
+def test_copy_raw_array_converts_dtype(rng):
+    out = blas.copy(np.ones((2, 2)), np.zeros((2, 2), dtype=np.float32))
+    assert out.dtype == jnp.float32
