@@ -62,7 +62,11 @@ def gemm(alpha, A, B, beta, C, opts=None):
     method = select_algo_gemm(A, B, C, opts)
     if method == MethodGemm.SUMMA:
         # explicit shard_map pipeline; requires distributed wrappers
-        from .parallel import summa
+        try:
+            from .parallel import summa
+        except ImportError as e:
+            raise SlateError("MethodGemm.SUMMA requires the distributed layer "
+                             "(slate_tpu.parallel)") from e
         out = summa.summa_gemm(alpha, A, B, beta, C, opts)
     else:
         # stationary-A/C both lower to one fused MXU matmul on a single array;

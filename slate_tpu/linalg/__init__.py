@@ -1,3 +1,10 @@
 """Driver algorithms (reference L4, src/*.cc)."""
 
 from .chol import posv, posv_mixed, potrf, potri, potrs, trtri, trtrm
+from .lu import (gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
+                 getrf, getrf_nopiv, getrf_tntpiv, getri, getrs, perm_to_pivots,
+                 rbt_generate)
+from .qr import (TriangularFactors, cholqr, gelqf, gels, geqrf, tsqr, unmlq, unmqr)
+from .eig import (hb2st, he2hb, heev, hegst, hegv, stedc, steqr, sterf)
+from .svd import bdsqr, ge2tb, svd, svd_vals, tb2bd
+from .condest import gecondest, norm1est, pocondest, trcondest

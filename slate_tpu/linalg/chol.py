@@ -267,11 +267,13 @@ def potri(A, opts=None, uplo=None):
 
 
 def _lower_precision(dtype):
-    """The reference factors f64 systems in f32 (gesv_mixed). TPU ladder:
-    f64->f32, f32->bf16, c128->c64."""
+    """The reference factors f64 systems in f32 (gesv_mixed): f64->f32, c128->c64.
+
+    f32 has no lower rung: XLA's LU/Cholesky do not accept bfloat16 operands (the
+    MXU already uses bf16 multipliers inside f32 matmuls), so f32 inputs fall back
+    to the plain full-precision solve."""
     mapping = {
         jnp.dtype(jnp.float64): jnp.float32,
-        jnp.dtype(jnp.float32): jnp.bfloat16,
         jnp.dtype(jnp.complex128): jnp.complex64,
     }
     return mapping.get(jnp.dtype(dtype))

@@ -1,0 +1,121 @@
+"""Condition number estimation: norm1est power iteration + gecondest / pocondest /
+trcondest.
+
+Reference analogue: ``src/norm1est.cc`` (internal one-norm estimator, the Hager/Higham
+power iteration used by LAPACK's xLACON), ``src/gecondest.cc``, ``src/pocondest.cc``,
+``src/trcondest.cc``.
+
+TPU re-design: the estimator needs only solve callbacks (A^{-1} x and A^{-H} x from an
+existing factorization) and elementwise sign/argmax steps — a natural
+``lax.while_loop``-shaped iteration; here host-unrolled to the standard <= 5 iteration
+bound with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import as_array
+from ..core.types import Norm, Options, Uplo
+from ..ops import norms as norm_ops
+
+
+def norm1est(solve: Callable, solve_h: Callable, n: int, dtype,
+             max_iter: int = 5) -> jax.Array:
+    """Estimate ||M||_1 where M is only available through matvec callbacks
+    (src/norm1est.cc; Hager-Higham with the classic parity-vector refinement).
+
+    `solve(x)` computes M x, `solve_h(x)` computes M^H x, both on (n,) vectors.
+    """
+    x = jnp.full((n,), 1.0 / n, dtype=dtype)
+    est = jnp.zeros((), jnp.real(x).dtype)
+    for _ in range(max_iter):
+        y = solve(x)
+        est = jnp.sum(jnp.abs(y))
+        s = jnp.where(jnp.abs(y) == 0, 1.0, y / jnp.where(jnp.abs(y) == 0, 1.0,
+                                                          jnp.abs(y)))
+        z = solve_h(s.astype(dtype))
+        j = jnp.argmax(jnp.abs(z))
+        x = jnp.zeros((n,), dtype=dtype).at[j].set(1.0)
+    # refinement with the alternating-parity vector (xLACON's final safeguard)
+    i = jnp.arange(n, dtype=jnp.real(x).dtype)
+    v = ((-1.0) ** i) * (1.0 + i / jnp.asarray(max(n - 1, 1), i.dtype))
+    alt = jnp.sum(jnp.abs(solve(v.astype(dtype)))) * 2.0 / (3.0 * n)
+    return jnp.maximum(est, alt)
+
+
+def gecondest(LU, perm, anorm, opts=None):
+    """1-norm reciprocal condition estimate from an LU factorization
+    (src/gecondest.cc): rcond = 1 / (||A||_1 * est(||A^{-1}||_1))."""
+    lu_ = as_array(LU)
+    n = lu_.shape[-1]
+
+    def solve(x):
+        pb = jnp.take(x, perm, axis=0) if perm is not None else x
+        y = lax.linalg.triangular_solve(lu_, pb[:, None], left_side=True,
+                                        lower=True, unit_diagonal=True)
+        return lax.linalg.triangular_solve(lu_, y, left_side=True,
+                                           lower=False)[:, 0]
+
+    def solve_h(x):
+        y = lax.linalg.triangular_solve(lu_, x[:, None], left_side=True,
+                                        lower=False, transpose_a=True,
+                                        conjugate_a=True)
+        z = lax.linalg.triangular_solve(lu_, y, left_side=True, lower=True,
+                                        unit_diagonal=True, transpose_a=True,
+                                        conjugate_a=True)[:, 0]
+        if perm is not None:
+            z = jnp.zeros_like(z).at[perm].set(z)
+        return z
+
+    inv_norm = norm1est(solve, solve_h, n, lu_.dtype)
+    rcond = 1.0 / (jnp.asarray(anorm, inv_norm.dtype) * inv_norm)
+    return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
+
+
+def pocondest(L, anorm, opts=None, uplo=None):
+    """Reciprocal condition estimate from a Cholesky factor (src/pocondest.cc)."""
+    f = as_array(L)
+    the_uplo = Uplo.from_string(uplo) if uplo else Uplo.Lower
+    Lf = jnp.tril(f) if the_uplo == Uplo.Lower else jnp.conj(jnp.triu(f).T)
+    n = f.shape[-1]
+
+    def solve(x):
+        y = lax.linalg.triangular_solve(Lf, x[:, None], left_side=True, lower=True)
+        return lax.linalg.triangular_solve(Lf, y, left_side=True, lower=True,
+                                           conjugate_a=True, transpose_a=True)[:, 0]
+
+    inv_norm = norm1est(solve, solve, n, f.dtype)
+    rcond = 1.0 / (jnp.asarray(anorm, inv_norm.dtype) * inv_norm)
+    return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
+
+
+def trcondest(T, opts=None, uplo=None, diag=None, norm_kind=Norm.One):
+    """Triangular condition estimate (src/trcondest.cc)."""
+    from ..blas import _diag_of
+    t = as_array(T)
+    the_uplo = Uplo.from_string(uplo) if uplo else getattr(T, "uplo", Uplo.Lower)
+    if the_uplo == Uplo.General:
+        the_uplo = Uplo.Lower
+    the_diag = _diag_of(T, diag)
+    n = t.shape[-1]
+    lower = the_uplo == Uplo.Lower
+    unit = the_diag.value == "unit"
+    anorm = norm_ops.trnorm(norm_kind, the_uplo, the_diag, t)
+
+    def solve(x):
+        return lax.linalg.triangular_solve(t, x[:, None], left_side=True,
+                                           lower=lower, unit_diagonal=unit)[:, 0]
+
+    def solve_h(x):
+        return lax.linalg.triangular_solve(t, x[:, None], left_side=True,
+                                           lower=lower, unit_diagonal=unit,
+                                           transpose_a=True, conjugate_a=True)[:, 0]
+
+    inv_norm = norm1est(solve, solve_h, n, t.dtype)
+    rcond = 1.0 / (anorm * inv_norm)
+    return jnp.where(jnp.isfinite(rcond), rcond, 0.0)

@@ -1,0 +1,567 @@
+"""LU family: getrf (partial-pivot / nopiv / tournament) + getrs / gesv / getri and the
+mixed-precision + random-butterfly solver variants.
+
+Reference analogue (SURVEY.md §2.4 LU row): ``src/getrf.cc`` (partial pivoting with the
+multithreaded panel of internal_getrf.cc + MPI pivot broadcast), ``src/getrf_nopiv.cc``,
+``src/getrf_tntpiv.cc`` (CALU tournament pivoting), ``src/{getrs,gesv,getri,getriOOP}.cc``,
+``src/gesv_mixed.cc`` (f32 factor + f64 iterative refinement), ``src/gesv_mixed_gmres.cc``
+(GMRES-IR), ``src/gesv_rbt.cc`` + ``src/gerbt.cc`` (random butterfly transform).
+
+TPU re-design:
+
+* **Pivot representation.** The reference keeps per-panel ``Pivots`` (tile index +
+  offset, types.hh:84-117) and swaps rows pairwise over MPI (internal_swap.cc).  Row
+  swaps are hostile to an SPMD machine; instead every factorization returns a *global
+  permutation vector* ``perm`` (PA = LU, perm[i] = source row) and row exchanges become
+  one XLA gather — the TPU-native form of permuteRows.  ``perm_to_pivots`` converts to
+  LAPACK/reference-style ipiv for API parity.
+
+* **Panel factorization.** The reference panel is a thread-team with an MPI maxloc
+  reduction per column (internal_getrf.cc:77-115).  Here the panel is
+  ``lax.linalg.lu`` on the tall block column — XLA's native partially-pivoted LU —
+  and the blocked driver composes panels exactly like getrf.cc's task loop: panel ->
+  permute left/right -> row trsm -> trailing gemm (the hot loop, getrf.cc:173-230).
+
+* **Tournament pivoting (CALU)** maps *better* to TPU than partial pivoting: each
+  round is a batched LU over row blocks + a tree reduction that halves the candidate
+  set (getrf_tntpiv.cc's panel; SURVEY.md §7 notes this is the better-fit default).
+  Implemented with static shapes: candidates are padded to nb rows per block.
+
+* **RBT** (gesv_rbt.cc:94-172): depth-d butterfly transforms are a perfect fit —
+  structured +/- mixing expressed as reshapes and elementwise ops, then nopiv LU.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.exceptions import SlateError
+from ..core.matrix import BaseMatrix, as_array, write_back
+from ..core.types import MethodLU, Options, Target
+from ..utils.trace import trace_block
+from .chol import _ir_solve
+
+
+# ---------------------------------------------------------------------------
+# pivots utilities
+# ---------------------------------------------------------------------------
+
+
+def perm_to_pivots(perm):
+    """Convert a permutation vector to LAPACK-style sequential ipiv (1-based),
+    the reference's Pivots representation (types.hh:84-117)."""
+    import numpy as np
+
+    p = np.asarray(perm).tolist()
+    n = len(p)
+    rows = list(range(n))
+    ipiv = np.zeros(n, dtype=np.int64)
+    for k in range(n):
+        j = rows.index(p[k], k)
+        ipiv[k] = j + 1
+        rows[k], rows[j] = rows[j], rows[k]
+    return ipiv
+
+
+def _compose_perm(outer, inner):
+    """perm = outer ∘ inner: result[i] = inner[outer[i]]."""
+    return jnp.take(inner, outer)
+
+
+def _lu_info(U_diag) -> jax.Array:
+    bad = jnp.isnan(U_diag) | (U_diag == 0)
+    return jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# nopiv panel kernel (used by getrf_nopiv and the RBT solver)
+# ---------------------------------------------------------------------------
+
+
+def _lu_nopiv_unblocked(a):
+    """Unblocked LU without pivoting on a square block via rank-1 updates
+    (≅ tile-level getrf_nopiv; Tile_getrf_nopiv semantics)."""
+    n = a.shape[-1]
+
+    def body(k, m):
+        col = m[:, k] / m[k, k]
+        col = jnp.where(jnp.arange(n) > k, col, m[:, k])
+        m = m.at[:, k].set(col)
+        row_mask = (jnp.arange(n)[:, None] > k) & (jnp.arange(n)[None, :] > k)
+        update = jnp.outer(col, m[k, :])
+        return jnp.where(row_mask, m - update, m)
+
+    return lax.fori_loop(0, n, body, a)
+
+
+@lru_cache(maxsize=32)
+def _getrf_nopiv_fn(m: int, n: int, nb: int, dtype_str: str):
+    nt = -(-min(m, n) // nb)
+
+    def fn(A):
+        for k in range(nt):
+            k0, k1 = k * nb, min((k + 1) * nb, min(m, n))
+            blk = _lu_nopiv_unblocked(A[k0:k1, k0:k1])
+            A = A.at[k0:k1, k0:k1].set(blk)
+            if k1 < m:
+                L21 = lax.linalg.triangular_solve(
+                    blk, A[k1:m, k0:k1], left_side=False, lower=False)  # X U = B
+                A = A.at[k1:m, k0:k1].set(L21)
+            if k1 < n:
+                U12 = lax.linalg.triangular_solve(
+                    blk, A[k0:k1, k1:n], left_side=True, lower=True,
+                    unit_diagonal=True)
+                A = A.at[k0:k1, k1:n].set(U12)
+            if k1 < m and k1 < n:
+                A = A.at[k1:m, k1:n].add(
+                    -jnp.matmul(A[k1:m, k0:k1], A[k0:k1, k1:n],
+                                precision=lax.Precision.HIGHEST))
+        return A
+
+    return jax.jit(fn)
+
+
+def getrf_nopiv(A, opts=None):
+    """LU without pivoting (src/getrf_nopiv.cc). Returns (LU, info)."""
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    with trace_block("getrf_nopiv", m=m, n=n):
+        out = _getrf_nopiv_fn(m, n, min(opts.block_size, m, n), str(a.dtype))(a)
+    info = _lu_info(jnp.diagonal(out, axis1=-2, axis2=-1))
+    return write_back(A, out), info
+
+
+# ---------------------------------------------------------------------------
+# partial-pivot getrf
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=32)
+def _getrf_tiled_fn(m: int, n: int, nb: int, dtype_str: str):
+    """Blocked right-looking partially-pivoted LU (getrf.cc task loop, software-
+    pipelined for XLA)."""
+    kmax = min(m, n)
+    nt = -(-kmax // nb)
+
+    def fn(A):
+        perm = jnp.arange(m)
+        for k in range(nt):
+            k0, k1 = k * nb, min((k + 1) * nb, kmax)
+            # --- panel (≅ internal::getrf_panel, getrf.cc:92-120) ---
+            panel = A[k0:m, k0:k1]
+            plu, _, pperm = lax.linalg.lu(panel)
+            L_pan = jnp.tril(plu[:, : k1 - k0], -1)
+            U_pan = jnp.triu(plu[: k1 - k0, :])
+            # permute trailing + left columns and the global perm (row gather —
+            # TPU-native permuteRows, internal_swap.cc analogue)
+            gperm = jnp.concatenate([jnp.arange(k0), k0 + pperm])
+            A = jnp.take(A, gperm, axis=0)
+            perm = jnp.take(perm, gperm)
+            A = A.at[k0:m, k0:k1].set(L_pan + jnp.pad(
+                U_pan, ((0, m - k0 - (k1 - k0)), (0, 0))))
+            if k1 < n:
+                # row trsm (≅ lookahead/trailing trsm, getrf.cc:121-155)
+                L11 = jnp.tril(plu[: k1 - k0, :], -1) + jnp.eye(
+                    k1 - k0, dtype=A.dtype)
+                U12 = lax.linalg.triangular_solve(
+                    L11, A[k0:k1, k1:n], left_side=True, lower=True,
+                    unit_diagonal=True)
+                A = A.at[k0:k1, k1:n].set(U12)
+                if k1 < m:
+                    # trailing gemm — the hot loop (getrf.cc:173-230)
+                    A = A.at[k1:m, k1:n].add(
+                        -jnp.matmul(A[k1:m, k0:k1], U12,
+                                    precision=lax.Precision.HIGHEST))
+        return A, perm
+
+    return jax.jit(fn)
+
+
+def getrf(A, opts=None):
+    """Partially-pivoted LU: returns (LU, perm, info) with A[perm] = L U
+    (src/getrf.cc:22-260; dispatch over MethodLU like gesv's select_algo).
+
+    MethodLU.CALU routes to tournament pivoting (getrf_tntpiv), NoPiv to getrf_nopiv
+    (perm = identity), RBT is reserved for gesv_rbt.
+    """
+    opts = Options.make(opts)
+    method = opts.method_lu
+    if method == MethodLU.Auto:
+        method = MethodLU.PartialPiv
+    if method == MethodLU.NoPiv:
+        lu_, info = getrf_nopiv(A, opts)
+        return lu_, jnp.arange(as_array(A).shape[-2]), info
+    if method == MethodLU.CALU:
+        return getrf_tntpiv(A, opts)
+    if method != MethodLU.PartialPiv:
+        raise SlateError(f"unsupported MethodLU {method}")
+
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    target = opts.target
+    if target == Target.Auto:
+        target = Target.XLA
+    with trace_block("getrf", m=m, n=n, target=str(target)):
+        if target == Target.XLA:
+            plu, _, perm = lax.linalg.lu(a)
+            out = plu
+        else:
+            out, perm = _getrf_tiled_fn(m, n, min(opts.block_size, m, n),
+                                        str(a.dtype))(a)
+    info = _lu_info(jnp.diagonal(out, axis1=-2, axis2=-1))
+    return write_back(A, out), perm, info
+
+
+# ---------------------------------------------------------------------------
+# tournament pivoting (CALU)
+# ---------------------------------------------------------------------------
+
+
+def _tournament_panel(panel, nb):
+    """Select nb pivot rows of a tall panel by tournament (getrf_tntpiv.cc panel:
+    block-local partially-pivoted LUs, then a binary reduction tree over winners;
+    internal_getrf_tntpiv.cc / Tile_getrf_tntpiv.hh semantics, re-expressed as a
+    static tree of lax.linalg.lu calls).
+
+    Returns the winning global row indices (length min(nb, mp)).
+    """
+    mp = panel.shape[0]
+    k = min(nb, mp)
+    # leaves: blocks of nb rows
+    blocks = []
+    for s in range(0, mp, nb):
+        rows = jnp.arange(s, min(s + nb, mp))
+        blocks.append((panel[s:min(s + nb, mp)], rows))
+    # reduction tree: LU each pair's stacked winners, keep top-k rows
+    while len(blocks) > 1:
+        nxt = []
+        for i in range(0, len(blocks) - 1, 2):
+            sub = jnp.concatenate([blocks[i][0], blocks[i + 1][0]], axis=0)
+            idx = jnp.concatenate([blocks[i][1], blocks[i + 1][1]])
+            _, _, perm = lax.linalg.lu(sub)
+            take = perm[: min(k, sub.shape[0])]
+            nxt.append((jnp.take(sub, take, axis=0), jnp.take(idx, take)))
+        if len(blocks) % 2 == 1:
+            nxt.append(blocks[-1])
+        blocks = nxt
+    sub, idx = blocks[0]
+    _, _, perm = lax.linalg.lu(sub)
+    take = perm[: min(k, sub.shape[0])]
+    return jnp.take(idx, take)
+
+
+@lru_cache(maxsize=32)
+def _getrf_tntpiv_fn(m: int, n: int, nb: int, dtype_str: str):
+    kmax = min(m, n)
+    nt = -(-kmax // nb)
+
+    def fn(A):
+        perm = jnp.arange(m)
+        for k in range(nt):
+            k0, k1 = k * nb, min((k + 1) * nb, kmax)
+            w = k1 - k0
+            panel = A[k0:m, k0:k1]
+            winners = _tournament_panel(panel, w)          # local indices into panel
+            rest_mask = jnp.ones(m - k0, dtype=bool).at[winners].set(False)
+            rest = jnp.where(rest_mask, jnp.arange(m - k0), m)  # push winners out
+            rest = jnp.sort(rest)[: m - k0 - w]
+            local = jnp.concatenate([winners, rest])
+            gperm = jnp.concatenate([jnp.arange(k0), k0 + local])
+            A = jnp.take(A, gperm, axis=0)
+            perm = jnp.take(perm, gperm)
+            # nopiv factor of the permuted panel (pivots already chosen)
+            blk = _lu_nopiv_unblocked(A[k0:k1, k0:k1])
+            A = A.at[k0:k1, k0:k1].set(blk)
+            if k1 < m:
+                L21 = lax.linalg.triangular_solve(
+                    blk, A[k1:m, k0:k1], left_side=False, lower=False)
+                A = A.at[k1:m, k0:k1].set(L21)
+            if k1 < n:
+                U12 = lax.linalg.triangular_solve(
+                    blk, A[k0:k1, k1:n], left_side=True, lower=True,
+                    unit_diagonal=True)
+                A = A.at[k0:k1, k1:n].set(U12)
+                if k1 < m:
+                    A = A.at[k1:m, k1:n].add(
+                        -jnp.matmul(A[k1:m, k0:k1], U12,
+                                    precision=lax.Precision.HIGHEST))
+        return A, perm
+
+    return jax.jit(fn)
+
+
+def getrf_tntpiv(A, opts=None):
+    """Tournament-pivoted (CALU) LU (src/getrf_tntpiv.cc:161-230).
+    Returns (LU, perm, info)."""
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    with trace_block("getrf_tntpiv", m=m, n=n):
+        out, perm = _getrf_tntpiv_fn(m, n, min(opts.block_size, m, n),
+                                     str(a.dtype))(a)
+    info = _lu_info(jnp.diagonal(out, axis1=-2, axis2=-1))
+    return write_back(A, out), perm, info
+
+
+# ---------------------------------------------------------------------------
+# solves
+# ---------------------------------------------------------------------------
+
+
+def getrs(LU, perm, B, opts=None, trans=False):
+    """Solve A X = B from the LU factor (src/getrs.cc: permuteRows(Forward) +
+    work::trsm(L) + work::trsm(U); here: one gather + two TriangularSolves)."""
+    lu_ = as_array(LU)
+    b = as_array(B)
+    if trans:
+        # A^T x = b  =>  U^T y = b; L^T z = y; x = perm^{-1} scatter
+        y = lax.linalg.triangular_solve(lu_, b, left_side=True, lower=False,
+                                        transpose_a=True)
+        z = lax.linalg.triangular_solve(lu_, y, left_side=True, lower=True,
+                                        unit_diagonal=True, transpose_a=True)
+        x = jnp.zeros_like(z).at[perm].set(z) if perm is not None else z
+        return write_back(B, x)
+    pb = jnp.take(b, perm, axis=0) if perm is not None else b
+    y = lax.linalg.triangular_solve(lu_, pb, left_side=True, lower=True,
+                                    unit_diagonal=True)
+    x = lax.linalg.triangular_solve(lu_, y, left_side=True, lower=False)
+    return write_back(B, x)
+
+
+def gesv(A, B, opts=None):
+    """Solve A X = B (src/gesv.cc = getrf + getrs). Returns (X, perm, info)."""
+    lu_, perm, info = getrf(A, opts)
+    X = getrs(lu_, perm, B, opts)
+    return X, perm, info
+
+
+def gesv_nopiv(A, B, opts=None):
+    """src/gesv_nopiv.cc."""
+    opts = Options.make(opts).replace(method_lu="nopiv")
+    return gesv(A, B, opts)
+
+
+def getri(A, opts=None):
+    """In-place inverse from LU (src/getri.cc, getriOOP.cc): solve A X = I."""
+    a = as_array(A)
+    n = a.shape[-1]
+    lu_, perm, info = getrf(A, opts)
+    X = getrs(lu_, perm, jnp.eye(n, dtype=a.dtype), opts)
+    return write_back(A, X), info
+
+
+# ---------------------------------------------------------------------------
+# mixed precision + GMRES-IR
+# ---------------------------------------------------------------------------
+
+
+def gesv_mixed(A, B, opts=None):
+    """Low-precision LU factor + working-precision iterative refinement
+    (src/gesv_mixed.cc:23-40,106+). Returns (X, perm, info, iters)."""
+    from .chol import _lower_precision
+
+    opts = Options.make(opts)
+    a = as_array(A)
+    b = as_array(B)
+    lo = opts.factor_precision or _lower_precision(a.dtype)
+    if lo is None:
+        X, perm, info = gesv(A, B, opts)
+        return X, perm, info, jnp.int32(0)
+
+    with trace_block("gesv_mixed", lo=str(lo)):
+        plu, _, perm = lax.linalg.lu(a.astype(lo))
+        info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
+
+        def solve_lo(rhs):
+            pb = jnp.take(rhs.astype(lo), perm, axis=0)
+            y = lax.linalg.triangular_solve(plu, pb, left_side=True, lower=True,
+                                            unit_diagonal=True)
+            return lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
+
+        x, iters, converged = _ir_solve(a, b, solve_lo, opts)
+
+    if opts.use_fallback_solver and not bool(converged):
+        X, perm, info = gesv(A, B, opts)
+        return X, perm, info, iters
+    return write_back(B, x), perm, info, iters
+
+
+def _fgmres(matvec, precond, b, x0, restart, tol, max_restarts):
+    """Restarted FGMRES with right preconditioning — static shapes, host-unrolled
+    restarts (src/gesv_mixed_gmres.cc uses GMRES-IR the same way)."""
+    x = x0
+    restarts = 0
+    for _ in range(max_restarts):
+        restarts += 1
+        r = b - matvec(x)
+        beta = jnp.linalg.norm(r)
+        V = jnp.zeros((restart + 1,) + b.shape, dtype=b.dtype)
+        Z = jnp.zeros((restart,) + b.shape, dtype=b.dtype)
+        H = jnp.zeros((restart + 1, restart), dtype=b.dtype)
+        V = V.at[0].set(r / jnp.where(beta == 0, 1, beta))
+        for j in range(restart):
+            z = precond(V[j])
+            w = matvec(z)
+            # modified Gram-Schmidt
+            for i in range(j + 1):
+                hij = jnp.vdot(V[i], w)
+                H = H.at[i, j].set(hij)
+                w = w - hij * V[i]
+            hn = jnp.linalg.norm(w)
+            H = H.at[j + 1, j].set(hn)
+            V = V.at[j + 1].set(w / jnp.where(hn == 0, 1, hn))
+            Z = Z.at[j].set(z)
+        # least squares min ||beta e1 - H y||
+        e1 = jnp.zeros(restart + 1, dtype=b.dtype).at[0].set(beta)
+        y, *_ = jnp.linalg.lstsq(H, e1)
+        x = x + jnp.tensordot(y, Z, axes=1)
+        if float(jnp.linalg.norm(b - matvec(x))) <= float(tol):
+            break
+    return x, restarts
+
+
+def gesv_mixed_gmres(A, B, opts=None):
+    """GMRES-IR: FGMRES in working precision, right-preconditioned by the
+    low-precision LU solve (src/gesv_mixed_gmres.cc). Single-RHS path like the
+    reference (it restricts to nrhs == 1). Returns (X, perm, info, iters)."""
+    from .chol import _lower_precision
+
+    opts = Options.make(opts)
+    a = as_array(A)
+    b = as_array(B)
+    squeeze = b.ndim == 1
+    if not squeeze and b.shape[-1] != 1:
+        raise SlateError("gesv_mixed_gmres supports a single RHS (matches reference)")
+    bv = b.reshape(-1) if not squeeze else b
+    lo = opts.factor_precision or _lower_precision(a.dtype)
+    if lo is None:
+        X, perm, info = gesv(A, B, opts)
+        return X, perm, info, jnp.int32(0)
+
+    with trace_block("gesv_mixed_gmres", lo=str(lo)):
+        plu, _, perm = lax.linalg.lu(a.astype(lo))
+        info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
+
+        def precond(r):
+            pb = jnp.take(r.astype(lo), perm, axis=0)
+            y = lax.linalg.triangular_solve(plu, pb[:, None], left_side=True,
+                                            lower=True, unit_diagonal=True)
+            z = lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
+            return z[:, 0].astype(b.dtype)
+
+        def matvec(x):
+            return jnp.matmul(a, x, precision=lax.Precision.HIGHEST)
+
+        n = a.shape[-1]
+        eps = jnp.finfo(bv.dtype).eps
+        tol = (opts.tolerance if opts.tolerance is not None
+               else float(eps) * (n ** 0.5)) * float(jnp.linalg.norm(bv))
+        x, restarts = _fgmres(matvec, precond, bv, precond(bv), restart=min(30, n),
+                              tol=tol, max_restarts=opts.max_iterations // 10 + 1)
+
+    x_out = x if squeeze else x[:, None]
+    resid = float(jnp.linalg.norm(bv - matvec(x)))
+    if opts.use_fallback_solver and resid > tol * 10:
+        X, perm, info = gesv(A, B, opts)
+        return X, perm, info, jnp.int32(-1)
+    return write_back(B, x_out), perm, info, jnp.int32(restarts)
+
+
+# ---------------------------------------------------------------------------
+# random butterfly transform (RBT)
+# ---------------------------------------------------------------------------
+
+
+def rbt_generate(key, n, depth, dtype):
+    """Generate the diagonals of a depth-d recursive butterfly transform
+    (src/internal/internal_gerbt.cc rbt_generate; matgen random signs).
+
+    Each level d has a diagonal of exp(r/10)-distributed entries like the classic
+    RBT construction; returns [depth, n] array of diagonal values.
+    """
+    r = jax.random.uniform(key, (depth, n), minval=-0.5, maxval=0.5)
+    return jnp.exp(r / 10.0).astype(dtype)
+
+
+def _butterfly_apply(W, x, transpose=False):
+    """Apply the depth-d butterfly U (or U^T) to the leading axis of x.
+
+    One level on a vector v of length 2h: with diagonals (r1, r2):
+        B v = [r1*v1 + r2*v2, r1*v1 - r2*v2] / sqrt(2)
+    Levels nest recursively on halves (gerbt.cc applies tile-wise; here the
+    recursion is expressed with reshapes so XLA fuses it into a few elementwise ops).
+    """
+    depth, n = W.shape
+    levels = range(depth - 1, -1, -1) if transpose else range(depth)
+    y = x
+    for d in levels:
+        nblk = 2 ** (depth - 1 - d)
+        h = n // (2 * nblk)
+        r = W[d] / jnp.sqrt(jnp.asarray(2.0, x.dtype))
+        shape = (nblk, 2, h) + x.shape[1:]
+        yv = y.reshape(shape)
+        rv = r.reshape(nblk, 2, h)
+        rv = rv.reshape(rv.shape + (1,) * (x.ndim - 1))
+        if not transpose:
+            a = rv[:, 0] * yv[:, 0]
+            bpart = rv[:, 1] * yv[:, 1]
+            top, bot = a + bpart, a - bpart
+        else:
+            # B^T w: v1 = r1*(w1 + w2), v2 = r2*(w1 - w2)
+            top = rv[:, 0] * (yv[:, 0] + yv[:, 1])
+            bot = rv[:, 1] * (yv[:, 0] - yv[:, 1])
+        y = jnp.stack([top, bot], axis=1).reshape(x.shape)
+    return y
+
+
+def gerbt(Wu, Wv, A):
+    """Two-sided butterfly transform A' = U^T A V (src/gerbt.cc)."""
+    a = as_array(A)
+    a1 = _butterfly_apply(Wu, a, transpose=True)
+    a2 = _butterfly_apply(Wv, a1.T, transpose=True).T
+    return write_back(A, a2)
+
+
+def gesv_rbt(A, B, opts=None, key=None):
+    """Solve via random butterfly transform + nopiv LU + refinement
+    (src/gesv_rbt.cc:94-172). Returns (X, info, iters)."""
+    opts = Options.make(opts)
+    a = as_array(A)
+    b = as_array(B)
+    n = a.shape[-1]
+    depth = opts.depth
+    # pad n to a multiple of 2^depth for the butterfly recursion
+    pad = (-n) % (2 ** depth)
+    key = key if key is not None else jax.random.PRNGKey(42)
+    ku, kv = jax.random.split(key)
+    np_ = n + pad
+    Wu = rbt_generate(ku, np_, depth, a.dtype)
+    Wv = rbt_generate(kv, np_, depth, a.dtype)
+    ap = jnp.pad(a, ((0, pad), (0, pad)))
+    if pad:
+        ap = ap.at[jnp.arange(n, np_), jnp.arange(n, np_)].set(1)
+    with trace_block("gesv_rbt", n=n, depth=depth):
+        at = _butterfly_apply(Wu, ap, transpose=True)
+        at = _butterfly_apply(Wv, at.T, transpose=True).T
+        lu_p, info = getrf_nopiv(at, opts)
+
+        def solve_rbt(rhs):
+            rp = jnp.pad(rhs, ((0, pad),) + ((0, 0),) * (rhs.ndim - 1))
+            y = _butterfly_apply(Wu, rp, transpose=True)
+            z = lax.linalg.triangular_solve(lu_p, y, left_side=True, lower=True,
+                                            unit_diagonal=True)
+            w = lax.linalg.triangular_solve(lu_p, z, left_side=True, lower=False)
+            x = _butterfly_apply(Wv, w, transpose=False)
+            return x[:n]
+
+        x, iters, converged = _ir_solve(a, b, solve_rbt, opts)
+
+    if opts.use_fallback_solver and not bool(converged):
+        X, _, info = gesv(A, B, opts)
+        return X, info, iters
+    return write_back(B, x), info, iters

@@ -1,0 +1,289 @@
+"""QR/LQ factorizations and least squares: geqrf / gelqf / unmqr / unmlq / tsqr (CAQR)
+/ cholqr / gels.
+
+Reference analogue (SURVEY.md §2.4 QR/LS row): ``src/geqrf.cc`` (CAQR: multithreaded
+Householder panel internal_geqrf.cc + triangle-triangle tree reduction
+internal_ttqrt.cc), ``src/gelqf.cc``, ``src/{unmqr,unmlq}.cc``, ``src/cholqr.cc``,
+``src/{gels,gels_qr,gels_cholqr}.cc``; ``TriangularFactors`` is the reference's
+``vector<Matrix>`` of block-reflector T factors (slate.hh:857).
+
+TPU re-design:
+
+* **Panel QR** is ``jnp.linalg.qr(mode='raw')`` — XLA's native Householder
+  factorization returning the packed V + tau form (the per-tile geqrf of
+  Tile_geqrf.hh).
+* **Block reflector T** (the reference accumulates it column-by-column in the panel
+  loop, internal_geqrf.cc:79-124) is computed *in closed form*: with V the unit lower
+  trapezoid and S = V^H V, orthogonality of Q = I - V T V^H forces
+  T^{-1} + T^{-H} = S, so ``T = inv(triu(S, 1) + diag(1/tau))`` — one gemm plus one
+  k x k triangular solve, fully MXU-parallel instead of a length-k recurrence.
+* **Applying Q** (unmqr/unmlq; reference replays the panel+tree tasks in reverse,
+  unmqr.cc + internal_ttmqr.cc) is three gemms: Q^H C = C - V (T^H (V^H C)).
+* **TSQR/CAQR tree** (ttqrt's triangle-triangle reduction over mesh rows) is
+  ``tsqr``: leaf QRs over row blocks + a binary tree of stacked-R QRs; the Q factor
+  is reconstructed down the tree.  This is the communication-avoiding shape that
+  rides a mesh axis all-gather (distributed form lives in parallel/).
+* **CholQR** (cholqr.cc; MethodCholQR Herk/Gemm variants for the Gram matrix) with
+  the CholeskyQR2 re-orthogonalization pass and a shifted retry when the Gram matrix
+  is numerically indefinite (the reference falls back to QR inside gels_cholqr).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.exceptions import SlateError
+from ..core.matrix import BaseMatrix, as_array, write_back
+from ..core.types import MethodGels, Op, Options, Side
+from ..utils.trace import trace_block
+from .chol import _chol_info
+
+
+@dataclasses.dataclass
+class TriangularFactors:
+    """Block-Householder factors (reference TriangularFactors, slate.hh:857):
+    ``packed`` holds R in the upper triangle and the reflector columns V below the
+    diagonal (LAPACK geqrf layout); ``tau`` the reflector scalars; ``T`` the k x k
+    block-reflector triangle."""
+
+    packed: jax.Array   # (m, k)
+    tau: jax.Array      # (k,)
+    T: jax.Array        # (k, k) upper triangular
+
+    @property
+    def m(self):
+        return self.packed.shape[-2]
+
+    @property
+    def k(self):
+        return self.tau.shape[-1]
+
+    def V(self) -> jax.Array:
+        """Unit lower-trapezoid reflector matrix."""
+        k = self.k
+        V = jnp.tril(self.packed, -1)[..., :, :k]
+        idx = jnp.arange(k)
+        return V.at[..., idx, idx].set(jnp.ones((), self.packed.dtype))
+
+    def Q(self, full: bool = False) -> jax.Array:
+        """Materialize the (reduced) orthogonal factor via householder_product."""
+        if not full:
+            return lax.linalg.householder_product(self.packed, self.tau)
+        m, k = self.m, self.k
+        pad = jnp.zeros((m, m - k), dtype=self.packed.dtype)
+        packed_f = jnp.concatenate([self.packed, pad], axis=-1)
+        tau_f = jnp.concatenate([self.tau, jnp.zeros((m - k,), self.tau.dtype)])
+        return lax.linalg.householder_product(packed_f, tau_f)
+
+    def R(self) -> jax.Array:
+        return jnp.triu(self.packed[..., : self.k, :])
+
+
+def _block_T(V, tau):
+    """Closed-form block-reflector triangle: T = inv(triu(S,1) + diag(1/tau)),
+    S = V^H V (see module docstring)."""
+    S = jnp.matmul(jnp.conj(jnp.swapaxes(V, -1, -2)), V,
+                   precision=lax.Precision.HIGHEST)
+    k = tau.shape[-1]
+    inv_tau = jnp.where(tau == 0, jnp.inf, 1.0 / tau)
+    Tinv = jnp.triu(S, 1) + jnp.zeros_like(S).at[..., jnp.arange(k), jnp.arange(k)
+                                                 ].set(inv_tau)
+    eye = jnp.eye(k, dtype=V.dtype)
+    T = lax.linalg.triangular_solve(Tinv, eye, left_side=True, lower=False)
+    # zero columns where tau == 0 (identity reflectors contribute nothing)
+    return jnp.where(tau[..., None, :] == 0, 0, T)
+
+
+def geqrf(A, opts=None):
+    """QR factorization A = Q R (src/geqrf.cc). Returns TriangularFactors; writes the
+    packed factor back into a Matrix wrapper (R in the upper triangle, V below)."""
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    k = min(m, n)
+    with trace_block("geqrf", m=m, n=n):
+        h, tau = jnp.linalg.qr(a, mode="raw")
+        packed = jnp.swapaxes(h, -1, -2)  # numpy raw convention is transposed
+        fac = TriangularFactors(packed=packed[..., :, :], tau=tau,
+                                T=None)  # type: ignore[arg-type]
+        V = jnp.tril(packed[..., :, :k], -1).at[..., jnp.arange(k), jnp.arange(k)
+                                                ].set(jnp.ones((), a.dtype))
+        fac.T = _block_T(V, tau)
+    write_back(A, packed) if isinstance(A, BaseMatrix) else None
+    return fac
+
+
+def gelqf(A, opts=None):
+    """LQ factorization A = L Q (src/gelqf.cc) via QR of A^H: A^H = Q1 R1 =>
+    A = R1^H Q1^H. Returns TriangularFactors of A^H."""
+    a = as_array(A)
+    fac = geqrf(jnp.conj(jnp.swapaxes(a, -1, -2)), opts)
+    if isinstance(A, BaseMatrix):
+        write_back(A, jnp.conj(jnp.swapaxes(fac.packed, -1, -2)))
+    return fac
+
+
+def unmqr(side, op, factors: TriangularFactors, C, opts=None):
+    """Multiply by Q from geqrf (src/unmqr.cc): C := op(Q) C or C op(Q) using the
+    compact WY form, Q = I - V T V^H."""
+    side = Side.from_string(side)
+    op = Op.from_string(op)
+    V = factors.V()
+    T = factors.T
+    c = as_array(C)
+    if op == Op.Trans and jnp.iscomplexobj(c):
+        # LAPACK unmqr likewise rejects plain transpose for complex factors
+        raise SlateError("unmqr: Op.Trans unsupported for complex; use ConjTrans")
+    Tm = T if op == Op.NoTrans else jnp.conj(jnp.swapaxes(T, -1, -2))
+    with trace_block("unmqr"):
+        if side == Side.Left:
+            # op(Q) C = C - V op(T) (V^H C)
+            W = jnp.matmul(jnp.conj(jnp.swapaxes(V, -1, -2)), c,
+                           precision=lax.Precision.HIGHEST)
+            out = c - jnp.matmul(V, jnp.matmul(Tm, W),
+                                 precision=lax.Precision.HIGHEST)
+        else:
+            # C op(Q) = C - (C V) op(T) V^H
+            W = jnp.matmul(c, V, precision=lax.Precision.HIGHEST)
+            out = c - jnp.matmul(jnp.matmul(W, Tm),
+                                 jnp.conj(jnp.swapaxes(V, -1, -2)),
+                                 precision=lax.Precision.HIGHEST)
+    return write_back(C, out)
+
+
+def unmlq(side, op, factors: TriangularFactors, C, opts=None):
+    """Multiply by Q from gelqf (src/unmlq.cc). With A = L Q, Q = Q1^H where Q1 is
+    the QR factor of A^H, so op(Q) flips the op on Q1."""
+    op = Op.from_string(op)
+    if op == Op.Trans and jnp.iscomplexobj(factors.packed):
+        raise SlateError("unmlq: Op.Trans unsupported for complex; use ConjTrans")
+    flip = {Op.NoTrans: Op.ConjTrans, Op.ConjTrans: Op.NoTrans,
+            Op.Trans: Op.NoTrans}[op]
+    return unmqr(side, flip, factors, C, opts)
+
+
+# ---------------------------------------------------------------------------
+# TSQR / CAQR tree
+# ---------------------------------------------------------------------------
+
+
+def tsqr(a, row_blocks: int = 0, nb: int = 1024):
+    """Tall-skinny QR by binary tree reduction (the CAQR pattern of
+    internal_ttqrt.cc: leaf QRs + pairwise triangle-triangle QRs up the tree).
+
+    Returns (Q, R) with Q explicit reduced (m x n).  The distributed version runs the
+    same tree over a mesh axis (parallel/).
+    """
+    m, n = a.shape[-2:]
+    if row_blocks <= 0:
+        row_blocks = max(1, min(m // max(n, 1), -(-m // nb)))
+    if row_blocks <= 1 or m < 2 * n:
+        return lax.linalg.qr(a, full_matrices=False)
+
+    # split into row blocks (pad to equal size)
+    bs = -(-m // row_blocks)
+    pad = bs * row_blocks - m
+    ap = jnp.pad(a, ((0, pad), (0, 0)))
+    blocks = ap.reshape(row_blocks, bs, n)
+    # leaf QRs, batched
+    Qs, Rs = lax.linalg.qr(blocks, full_matrices=False)
+    levels = [Qs]  # per-level Q stacks
+    while Rs.shape[0] > 1:
+        nblk = Rs.shape[0]
+        if nblk % 2 == 1:
+            Rs = jnp.concatenate([Rs, jnp.zeros((1, n, n), Rs.dtype)], axis=0)
+            nblk += 1
+        paired = Rs.reshape(nblk // 2, 2 * n, n)
+        Qp, Rs = lax.linalg.qr(paired, full_matrices=False)
+        levels.append(Qp)
+    R = Rs[0]
+    # reconstruct Q down the tree: start from the root's identity coupling
+    Qacc = jnp.eye(n, dtype=a.dtype)[None]          # (1, n, n)
+    for Qp in reversed(levels[1:]):
+        npair = Qp.shape[0]
+        # each pair contributes two n-row slices of Q
+        Qfull = jnp.matmul(Qp, Qacc[:npair])        # (npair, 2n, n)
+        Qacc = Qfull.reshape(npair * 2, n, n)
+    Qacc = Qacc[: levels[0].shape[0]]
+    Q = jnp.matmul(levels[0], Qacc).reshape(row_blocks * bs, n)[:m]
+    return Q, R
+
+
+def cholqr(A, opts=None):
+    """Cholesky QR (src/cholqr.cc): R = chol(A^H A)^H upper, Q = A R^{-1}, with a
+    CholeskyQR2 second pass for orthogonality and a shifted retry if the Gram matrix
+    is numerically indefinite. Returns (Q, R)."""
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+
+    def one_pass(x):
+        G = jnp.matmul(jnp.conj(jnp.swapaxes(x, -1, -2)), x,
+                       precision=lax.Precision.HIGHEST)
+        L = lax.linalg.cholesky(G)
+        info = _chol_info(L)
+        Q = lax.linalg.triangular_solve(L, x, left_side=False, lower=True,
+                                        conjugate_a=True, transpose_a=True)
+        return Q, jnp.conj(jnp.swapaxes(L, -1, -2)), info
+
+    with trace_block("cholqr", m=m, n=n):
+        Q1, R1, info = one_pass(a)
+        if int(info) != 0:
+            # shifted retry (stabilized CholeskyQR): shift Gram by ~11(mn+n^2) eps ||A||^2
+            eps = jnp.finfo(a.dtype).eps
+            shift = 11.0 * (m * n + n * (n + 1)) * eps * (jnp.linalg.norm(a) ** 2)
+            G = jnp.matmul(jnp.conj(a.T), a) + shift * jnp.eye(n, dtype=a.dtype)
+            L = lax.linalg.cholesky(G)
+            Q1 = lax.linalg.triangular_solve(L, a, left_side=False, lower=True,
+                                             conjugate_a=True, transpose_a=True)
+            R1 = jnp.conj(L.T)
+        # CholeskyQR2: re-orthogonalize
+        Q2, R2, _ = one_pass(Q1)
+        R = jnp.matmul(R2, R1, precision=lax.Precision.HIGHEST)
+    return Q2, R
+
+
+def gels(A, BX, opts=None):
+    """Least squares min ||A X - B|| / minimum-norm solve (src/gels.cc dispatch:
+    MethodGels QR vs CholQR; src/gels_qr.cc, src/gels_cholqr.cc).
+
+    Overdetermined (m >= n): X = R^{-1} Q^H B.  Underdetermined: minimum-norm via LQ.
+    Returns the n x nrhs solution.
+    """
+    opts = Options.make(opts)
+    a = as_array(A)
+    b = as_array(BX)
+    m, n = a.shape[-2:]
+    method = opts.method_gels
+    if method == MethodGels.Auto:
+        # cholqr for very tall well-shaped panels (the reference's heuristic picks
+        # cholqr when tall-skinny), qr otherwise
+        method = MethodGels.CholQR if m >= 4 * n else MethodGels.QR
+
+    with trace_block("gels", m=m, n=n, method=str(method)):
+        if m >= n:
+            if method == MethodGels.CholQR:
+                Q, R = cholqr(a, opts)
+                y = jnp.matmul(jnp.conj(jnp.swapaxes(Q, -1, -2)), b,
+                               precision=lax.Precision.HIGHEST)
+            else:
+                fac = geqrf(a, opts)
+                y = unmqr("left", "c", fac, b)[..., :n, :]
+                R = fac.R()
+            x = lax.linalg.triangular_solve(R, y[..., :n, :], left_side=True,
+                                            lower=False)
+        else:
+            # minimum-norm: A = L Q, x = Q^H L^{-1} b
+            fac = gelqf(a, opts)
+            L = jnp.conj(jnp.swapaxes(fac.R(), -1, -2))   # m x m lower
+            y = lax.linalg.triangular_solve(L, b, left_side=True, lower=True)
+            ypad = jnp.concatenate(
+                [y, jnp.zeros((n - m,) + y.shape[1:], y.dtype)], axis=0)
+            x = unmqr("left", "n", fac, ypad)  # Q1 ypad = Q^H ypad
+    return write_back(BX, x) if (isinstance(BX, BaseMatrix)
+                                 and as_array(BX).shape == x.shape) else x

@@ -1,0 +1,163 @@
+"""Eig/SVD/condest tests (reference: test/test_heev.cc — ||A Z - Z L|| and
+orthogonality gates; test_svd.cc; test_gecondest.cc vs true condition number)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import linalg
+
+
+def _herm(rng, n, cplx=False):
+    a = rng.standard_normal((n, n))
+    if cplx:
+        a = a + 1j * rng.standard_normal((n, n))
+    a = (a + a.conj().T) / 2
+    return a
+
+
+@pytest.mark.parametrize("cplx", [False, True])
+def test_heev(rng, cplx):
+    n = 20
+    a = _herm(rng, n, cplx)
+    A = slate.HermitianMatrix.from_array("lower", a.copy(), nb=8)
+    lam, Z = linalg.heev(A)
+    lam, Z = np.asarray(lam), np.asarray(Z)
+    assert np.all(np.diff(lam) >= -1e-12)
+    resid = np.linalg.norm(a @ Z - Z * lam) / (np.linalg.norm(a) * n)
+    assert resid < 1e-14
+    assert np.linalg.norm(Z.conj().T @ Z - np.eye(n)) < 1e-12
+    lam2, _ = linalg.heev(a, want_vectors=False)
+    np.testing.assert_allclose(np.asarray(lam2), lam, rtol=1e-12, atol=1e-12)
+
+
+def test_heev_scaling_extreme_norm(rng):
+    n = 10
+    a = _herm(rng, n) * 1e-200   # would underflow without the pre-scale
+    lam, Z = linalg.heev(a)
+    ref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.asarray(lam), ref, rtol=1e-10, atol=1e-215)
+
+
+def test_hegv(rng):
+    n = 14
+    a = _herm(rng, n, cplx=True)
+    b = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    b = b @ b.conj().T + n * np.eye(n)
+    lam, Z = linalg.hegv(1, a.copy(), b.copy())
+    lam, Z = np.asarray(lam), np.asarray(Z)
+    # A z = lambda B z
+    resid = np.linalg.norm(a @ Z - b @ Z * lam) / (np.linalg.norm(a) * n)
+    assert resid < 1e-12
+    import scipy.linalg  # available as jax dependency
+    ref = scipy.linalg.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(lam, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_two_stage_pipeline_matches_heev(rng):
+    n = 12
+    a = _herm(rng, n, cplx=True)
+    band, reflectors, taus = linalg.he2hb(a.copy())
+    d, e = linalg.hb2st(band)
+    lam = np.asarray(linalg.sterf(d, e))
+    ref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.sort(lam), ref, rtol=1e-10, atol=1e-10)
+
+
+def test_steqr_with_z(rng):
+    n = 9
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    lam, Q = linalg.steqr(jnp.asarray(d), jnp.asarray(e))
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    resid = np.linalg.norm(T @ np.asarray(Q) - np.asarray(Q) * np.asarray(lam))
+    assert resid < 1e-12
+
+
+@pytest.mark.parametrize("shape", [(18, 18), (48, 12), (12, 48)])
+def test_svd(rng, shape):
+    m, n = shape
+    a = rng.standard_normal((m, n))
+    S, U, VT = linalg.svd(a)
+    S, U, VT = np.asarray(S), np.asarray(U), np.asarray(VT)
+    k = min(m, n)
+    assert np.all(np.diff(S) <= 1e-12)
+    resid = np.linalg.norm(U @ np.diag(S) @ VT - a) / np.linalg.norm(a)
+    assert resid < 1e-13
+    assert np.linalg.norm(U.T @ U - np.eye(k)) < 1e-12
+    assert np.linalg.norm(VT @ VT.T - np.eye(k)) < 1e-12
+    np.testing.assert_allclose(np.asarray(linalg.svd_vals(a)), S, rtol=1e-12)
+
+
+def test_ge2tb_tb2bd_bdsqr(rng):
+    m, n = 10, 8
+    a = rng.standard_normal((m, n))
+    d, e, U, VT = linalg.ge2tb(a.copy())
+    # bidiagonal reconstruct: U B V^H = A
+    B = np.zeros((m, n))
+    k = min(m, n)
+    B[np.arange(k), np.arange(k)] = np.asarray(d)
+    B[np.arange(k - 1), np.arange(1, k)] = np.asarray(e)[: k - 1]
+    Uf = np.asarray(U)
+    np.testing.assert_allclose(Uf @ B[:k, :] @ np.asarray(VT), a,
+                               rtol=1e-9, atol=1e-9)
+    S, _, _ = linalg.bdsqr(d, e)
+    np.testing.assert_allclose(np.asarray(S), np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_condest(rng):
+    n = 16
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.logspace(0, 4, n)
+    a = (q * d) @ q.T
+    lu_arr, perm, info = linalg.getrf(a.copy())
+    anorm = float(slate.norm("one", slate.Matrix.from_array(a, nb=8)))
+    rcond = float(linalg.gecondest(lu_arr, perm, anorm))
+    true_rcond = 1.0 / (np.linalg.norm(a, 1) * np.linalg.norm(np.linalg.inv(a), 1))
+    assert 0.05 * true_rcond < rcond < 20 * true_rcond
+    # pocondest on SPD
+    spd = a @ a.T + np.eye(n)
+    L, info = linalg.potrf(spd.copy())
+    anorm_spd = np.linalg.norm(spd, 1)
+    rc = float(linalg.pocondest(L, anorm_spd))
+    true_rc = 1.0 / (anorm_spd * np.linalg.norm(np.linalg.inv(spd), 1))
+    assert 0.05 * true_rc < rc < 20 * true_rc
+    # trcondest
+    t = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    rc_t = float(linalg.trcondest(t, uplo="lower"))
+    true_t = 1.0 / (np.linalg.norm(np.tril(t), 1)
+                    * np.linalg.norm(np.linalg.inv(np.tril(t)), 1))
+    assert 0.05 * true_t < rc_t < 20 * true_t
+
+
+@pytest.mark.parametrize("itype", [1, 2, 3])
+def test_hegv_itypes(rng, itype):
+    n = 10
+    a = _herm(rng, n, cplx=True)
+    b = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    b = b @ b.conj().T + n * np.eye(n)
+    lam, Z = linalg.hegv(itype, a.copy(), b.copy())
+    lam, Z = np.asarray(lam), np.asarray(Z)
+    if itype == 1:
+        resid = np.linalg.norm(a @ Z - b @ Z * lam)
+    elif itype == 2:
+        resid = np.linalg.norm(a @ b @ Z - Z * lam)
+    else:
+        resid = np.linalg.norm(b @ a @ Z - Z * lam)
+    assert resid / (np.linalg.norm(a) * np.linalg.norm(b)) < 1e-11
+
+
+def test_ge2tb_complex(rng):
+    m, n = 7, 6
+    a = rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+    d, e, U, VT = linalg.ge2tb(a.copy())
+    k = min(m, n)
+    B = np.zeros((k, n))
+    B[np.arange(k), np.arange(k)] = np.asarray(d)
+    B[np.arange(k - 1), np.arange(1, k)] = np.asarray(e)[: k - 1]
+    recon = np.asarray(U) @ B @ np.asarray(VT)
+    assert np.linalg.norm(recon - a) / np.linalg.norm(a) < 1e-12
+    np.testing.assert_allclose(np.asarray(linalg.bdsqr(d, e)[0]),
+                               np.linalg.svd(a, compute_uv=False), rtol=1e-9)

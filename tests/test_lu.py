@@ -1,0 +1,180 @@
+"""LU family tests (reference: test/test_gesv.cc — residual gate
+||b - A x|| / (||A|| ||x|| n eps); test_getri; gesv_mixed / gesv_rbt testers)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import linalg
+from slate_tpu.linalg import lu as lu_mod
+
+
+def _gen(rng, m, n, cplx=False):
+    a = rng.standard_normal((m, n))
+    if cplx:
+        a = a + 1j * rng.standard_normal((m, n))
+    return a
+
+
+def _check_lu(a, lu_arr, perm):
+    m, n = a.shape
+    k = min(m, n)
+    L = np.tril(np.asarray(lu_arr), -1)[:, :k] + np.eye(m, k)
+    U = np.triu(np.asarray(lu_arr))[:k, :]
+    pa = a[np.asarray(perm)]
+    return np.linalg.norm(pa - L @ U) / np.linalg.norm(a)
+
+
+@pytest.mark.parametrize("target", ["xla", "tiled"])
+def test_getrf_partial_pivot(rng, target):
+    n = 29
+    a = _gen(rng, n, n)
+    A = slate.Matrix.from_array(a.copy(), nb=8)
+    lu_arr, perm, info = linalg.getrf(A, {"target": target, "block_size": 8})
+    assert int(info) == 0
+    assert _check_lu(a, lu_arr, perm) < 1e-13
+    assert sorted(np.asarray(perm).tolist()) == list(range(n))
+
+
+def test_getrf_rectangular_tiled(rng):
+    a = _gen(rng, 19, 11)
+    lu_arr, perm, info = linalg.getrf(a, {"target": "tiled", "block_size": 4})
+    assert _check_lu(a, lu_arr, perm) < 1e-13
+
+
+def test_getrf_nopiv_diag_dominant(rng):
+    n = 21
+    a = _gen(rng, n, n) + n * np.eye(n)
+    lu_arr, info = linalg.getrf_nopiv(a, {"block_size": 6})
+    assert int(info) == 0
+    L = np.tril(np.asarray(lu_arr), -1) + np.eye(n)
+    U = np.triu(np.asarray(lu_arr))
+    assert np.linalg.norm(a - L @ U) / np.linalg.norm(a) < 1e-12
+
+
+def test_getrf_tntpiv(rng):
+    n = 26
+    a = _gen(rng, n, n)
+    lu_arr, perm, info = linalg.getrf(a, {"method_lu": "calu", "block_size": 5})
+    assert int(info) == 0
+    assert _check_lu(a, lu_arr, perm) < 1e-11
+    assert sorted(np.asarray(perm).tolist()) == list(range(n))
+
+
+@pytest.mark.parametrize("method", ["partialpiv", "calu"])
+def test_gesv(rng, method):
+    n, nrhs = 24, 3
+    a = _gen(rng, n, n)
+    b = _gen(rng, n, nrhs)
+    A = slate.Matrix.from_array(a.copy(), nb=8)
+    B = slate.Matrix.from_array(b.copy(), nb=8)
+    X, perm, info = linalg.gesv(A, B, {"method_lu": method, "target": "tiled",
+                                       "block_size": 8})
+    x = np.asarray(X)
+    resid = np.linalg.norm(b - a @ x) / (np.linalg.norm(a) * np.linalg.norm(x) * n)
+    assert resid < 1e-14
+
+
+def test_getrs_trans(rng):
+    n = 16
+    a = _gen(rng, n, n)
+    b = _gen(rng, n, 2)
+    lu_arr, perm, info = linalg.getrf(a.copy())
+    x = linalg.getrs(lu_arr, perm, b.copy(), trans=True)
+    resid = np.linalg.norm(b - a.T @ np.asarray(x)) / np.linalg.norm(b)
+    assert resid < 1e-11
+
+
+def test_getri(rng):
+    n = 18
+    a = _gen(rng, n, n)
+    A = slate.Matrix.from_array(a.copy(), nb=6)
+    inv, info = linalg.getri(A)
+    np.testing.assert_allclose(np.asarray(inv) @ a, np.eye(n), atol=1e-10)
+
+
+def test_gesv_mixed(rng):
+    n = 32
+    a = _gen(rng, n, n) + n * np.eye(n)
+    b = _gen(rng, n, 2)
+    X, perm, info, iters = linalg.gesv_mixed(a, b.copy())
+    x = np.asarray(X)
+    resid = np.linalg.norm(b - a @ x) / (np.linalg.norm(a) * np.linalg.norm(x))
+    assert resid < 1e-12
+    assert int(iters) >= 1
+
+
+def test_gesv_mixed_gmres(rng):
+    n = 24
+    a = _gen(rng, n, n) + n * np.eye(n)
+    b = _gen(rng, n, 1)
+    X, perm, info, iters = linalg.gesv_mixed_gmres(a, b.copy())
+    x = np.asarray(X)
+    resid = np.linalg.norm(b - a @ x) / np.linalg.norm(b)
+    assert resid < 1e-10
+
+
+def test_butterfly_transform_consistency(rng):
+    # U^T A V with x = V y must satisfy A x = b when A' y = U^T b
+    n, depth = 16, 2
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    Wu = lu_mod.rbt_generate(ku, n, depth, jnp.float64)
+    Wv = lu_mod.rbt_generate(kv, n, depth, jnp.float64)
+    a = jnp.asarray(_gen(rng, n, n))
+    at = lu_mod._butterfly_apply(Wu, a, transpose=True)
+    at = lu_mod._butterfly_apply(Wv, at.T, transpose=True).T
+    # dense U and V from applying to identity
+    U = lu_mod._butterfly_apply(Wu, jnp.eye(n), transpose=False)
+    V = lu_mod._butterfly_apply(Wv, jnp.eye(n), transpose=False)
+    np.testing.assert_allclose(np.asarray(at), np.asarray(U).T @ np.asarray(a) @ np.asarray(V),
+                               rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("n", [16, 19])  # 19 exercises the padding path
+def test_gesv_rbt(rng, n):
+    a = _gen(rng, n, n) + 2 * np.eye(n)
+    b = _gen(rng, n, 2)
+    X, info, iters = linalg.gesv_rbt(a, b.copy(), {"depth": 2})
+    x = np.asarray(X)
+    resid = np.linalg.norm(b - a @ x) / (np.linalg.norm(a) * np.linalg.norm(x))
+    assert resid < 1e-12
+
+
+def test_perm_to_pivots_roundtrip(rng):
+    n = 12
+    a = _gen(rng, n, n)
+    lu_arr, perm, info = linalg.getrf(a)
+    ipiv = lu_mod.perm_to_pivots(perm)
+    # simulate LAPACK swaps on the original matrix rows; must equal a[perm]
+    rows = np.arange(n)
+    for k in range(n):
+        j = ipiv[k] - 1
+        rows[[k, j]] = rows[[j, k]]
+    np.testing.assert_array_equal(rows, np.asarray(perm))
+
+
+def test_gesv_mixed_f32_falls_back_cleanly(rng):
+    # f32 has no lower factor rung (bf16 unsupported by XLA linalg): plain solve
+    n = 12
+    a = (np.eye(n) * n + _gen(rng, n, n)).astype(np.float32)
+    b = _gen(rng, n, 1).astype(np.float32)
+    X, perm, info, iters = linalg.gesv_mixed(a, b.copy())
+    assert int(iters) == 0
+    resid = np.linalg.norm(b - a @ np.asarray(X)) / np.linalg.norm(b)
+    assert resid < 1e-4
+
+
+def test_gemm_summa_without_distributed_layer_raises():
+    import slate_tpu as slate
+    a = np.ones((4, 4))
+    try:
+        slate.gemm(1.0, a, a, 0.0, a.copy(), {"method_gemm": "summa"})
+    except slate.SlateError:
+        pass  # clear library error expected (if parallel layer absent)
+    # if the parallel layer exists, SUMMA must produce the right product
+    else:
+        got = slate.gemm(1.0, a, a, 0.0, np.zeros((4, 4)), {"method_gemm": "summa"})
+        np.testing.assert_allclose(np.asarray(got), a @ a)

@@ -1,0 +1,102 @@
+"""QR/LS tests (reference: test/test_geqrf.cc — ||A - QR|| and ||I - Q^H Q||
+orthogonality gates; test_gels.cc residual checks; unit_test/test_qr.cc tree kernels)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import linalg
+from slate_tpu.linalg.qr import tsqr
+
+
+def _gen(rng, m, n, cplx=False):
+    a = rng.standard_normal((m, n))
+    if cplx:
+        a = a + 1j * rng.standard_normal((m, n))
+    return a
+
+
+@pytest.mark.parametrize("cplx", [False, True])
+def test_geqrf_reconstruct(rng, cplx):
+    m, n = 23, 11
+    a = _gen(rng, m, n, cplx)
+    A = slate.Matrix.from_array(a.copy(), nb=8)
+    fac = linalg.geqrf(A)
+    Q, R = np.asarray(fac.Q()), np.asarray(fac.R())
+    assert np.linalg.norm(Q @ R - a) / np.linalg.norm(a) < 1e-13
+    assert np.linalg.norm(Q.conj().T @ Q - np.eye(n)) < 1e-13
+    # packed form written back: R in the upper triangle
+    np.testing.assert_allclose(np.triu(np.asarray(A.array)[:n, :]), R, rtol=1e-12)
+
+
+@pytest.mark.parametrize("cplx", [False, True])
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("op", ["n", "c"])
+def test_unmqr_matches_explicit_q(rng, cplx, side, op):
+    m, n = 17, 7
+    a = _gen(rng, m, n, cplx)
+    fac = linalg.geqrf(a)
+    Qf = np.asarray(fac.Q(full=True))
+    Qop = Qf if op == "n" else Qf.conj().T
+    c = _gen(rng, m, 5, cplx) if side == "left" else _gen(rng, 5, m, cplx)
+    got = np.asarray(linalg.unmqr(side, op, fac, c.copy()))
+    ref = Qop @ c if side == "left" else c @ Qop
+    np.testing.assert_allclose(got, ref, rtol=1e-10, atol=1e-10)
+
+
+def test_gelqf_unmlq(rng):
+    m, n = 9, 21
+    a = _gen(rng, m, n, cplx=True)
+    fac = linalg.gelqf(a.copy())
+    L = np.conj(np.asarray(fac.R()).T)      # m x m lower
+    # A = L Q with Q = Q1^H (n->... reduced): reconstruct
+    Q1 = np.asarray(fac.Q())                # n x m
+    np.testing.assert_allclose(L @ Q1.conj().T, a, rtol=1e-11, atol=1e-11)
+    # unmlq applies Q: Q = Q1^H; check op(Q)=n on the left of an m-row block
+    c = _gen(rng, n, 3, cplx=True)
+    got = np.asarray(linalg.unmlq("left", "c", fac, c.copy()))
+    Qfull = np.asarray(fac.Q(full=True))    # n x n (full Q1)
+    np.testing.assert_allclose(got, Qfull @ c, rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("m,blocks", [(64, 4), (100, 3), (37, 0)])
+def test_tsqr_tree(rng, m, blocks):
+    n = 5
+    a = _gen(rng, m, n)
+    Q, R = tsqr(jnp.asarray(a), row_blocks=blocks)
+    Q, R = np.asarray(Q), np.asarray(R)
+    assert np.linalg.norm(Q @ R - a) / np.linalg.norm(a) < 1e-13
+    assert np.linalg.norm(Q.T @ Q - np.eye(n)) < 1e-12
+    # R upper triangular up to sign
+    np.testing.assert_allclose(np.tril(R, -1), 0, atol=1e-13)
+
+
+def test_cholqr(rng):
+    m, n = 200, 8
+    a = _gen(rng, m, n)
+    Q, R = linalg.cholqr(a)
+    Q, R = np.asarray(Q), np.asarray(R)
+    assert np.linalg.norm(Q @ R - a) / np.linalg.norm(a) < 1e-12
+    assert np.linalg.norm(Q.T @ Q - np.eye(n)) < 1e-13
+    np.testing.assert_allclose(np.tril(R, -1), 0, atol=1e-12)
+
+
+@pytest.mark.parametrize("method", ["qr", "cholqr"])
+def test_gels_overdetermined(rng, method):
+    m, n, nrhs = 60, 10, 2
+    a = _gen(rng, m, n)
+    b = _gen(rng, m, nrhs)
+    x = np.asarray(linalg.gels(a, b, {"method_gels": method}))
+    ref, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_gels_underdetermined_minimum_norm(rng):
+    m, n = 8, 20
+    a = _gen(rng, m, n)
+    b = _gen(rng, m, 2)
+    x = np.asarray(linalg.gels(a, b))
+    ref, *_ = np.linalg.lstsq(a, b, rcond=None)  # lstsq gives min-norm
+    np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(a @ x, b, rtol=1e-9, atol=1e-9)
