@@ -22,6 +22,18 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
 
 from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
                    scale_row_col, set, symm, syr2k, syrk, trmm, trsm)
-from .linalg import posv, posv_mixed, potrf, potri, potrs, trtri, trtrm
+from .linalg import (bdsqr, cholqr, ge2tb, gecondest, gelqf, gels, geqrf, gerbt,
+                     gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
+                     getrf, getrf_nopiv, getrf_tntpiv, getri, getrs, hb2st, he2hb,
+                     heev, hegst, hegv, norm1est, pocondest, posv, posv_mixed,
+                     potrf, potri, potrs, stedc, steqr, sterf, svd, svd_vals,
+                     tb2bd, trcondest, trtri, trtrm, unmlq, unmqr)
+try:
+    # distributed layer needs jax.shard_map / NamedSharding; single-device use of
+    # the library must survive without it (blas.py raises a clear SlateError if a
+    # SUMMA method is requested while it is absent)
+    from . import parallel
+except ImportError:  # pragma: no cover - environment-specific
+    parallel = None
 
 __version__ = "0.1.0"

@@ -55,11 +55,8 @@ def gecondest(LU, perm, anorm, opts=None):
     n = lu_.shape[-1]
 
     def solve(x):
-        pb = jnp.take(x, perm, axis=0) if perm is not None else x
-        y = lax.linalg.triangular_solve(lu_, pb[:, None], left_side=True,
-                                        lower=True, unit_diagonal=True)
-        return lax.linalg.triangular_solve(lu_, y, left_side=True,
-                                           lower=False)[:, 0]
+        from .lu import lu_factored_solve
+        return lu_factored_solve(lu_, perm, x[:, None])[:, 0]
 
     def solve_h(x):
         y = lax.linalg.triangular_solve(lu_, x[:, None], left_side=True,

@@ -154,11 +154,19 @@ def he2hb(A, opts=None, uplo=None):
 
 def hb2st(band, opts=None):
     """Stage 2: band -> real symmetric tridiagonal (src/hb2st.cc bulge chasing).
-    With he2hb already producing tridiagonal form, this extracts (d, e); for a
-    general band input it reduces via the standard solver path."""
+    With he2hb already producing tridiagonal form, this extracts (d, e); a wider
+    band is reduced through the dense Householder tridiagonalization (one fused XLA
+    op — the single-device stand-in for the O(n*kd) bulge chase, which the reference
+    also confines to one rank, heev.cc:137-160)."""
     b = as_array(band)
     n = b.shape[-1]
     idx = jnp.arange(n)
+    # detect content beyond the first sub/superdiagonal (band is stored dense)
+    beyond = jnp.tril(b, -2)
+    if n > 2 and bool(jnp.any(jnp.abs(beyond) > 0)):
+        full = jnp.tril(b) + jnp.conj(jnp.swapaxes(jnp.tril(b, -1), -1, -2))
+        _, d, e, _ = lax.linalg.tridiagonal(full, lower=True)
+        return jnp.real(d), jnp.abs(e)
     d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))
     e_c = b[..., idx[1:], idx[:-1]]
     # rotate away complex phases on the subdiagonal (the unitary diagonal similarity
@@ -167,29 +175,27 @@ def hb2st(band, opts=None):
     return d, e
 
 
+def _assemble_tridiag(d, e) -> jax.Array:
+    """Dense symmetric tridiagonal from (diag, offdiag) — shared by sterf/steqr."""
+    n = d.shape[-1]
+    idx = jnp.arange(n)
+    T = jnp.zeros((n, n), dtype=d.dtype)
+    T = T.at[idx, idx].set(d)
+    T = T.at[idx[1:], idx[:-1]].set(e)
+    return T.at[idx[:-1], idx[1:]].set(e)
+
+
 def sterf(d, e, opts=None):
     """Eigenvalues of a real symmetric tridiagonal (src/sterf.cc wraps
     lapack::sterf on rank 0; here: one XLA eigvalsh on the assembled tridiagonal —
     the single-device equivalent)."""
-    n = d.shape[-1]
-    T = jnp.zeros((n, n), dtype=d.dtype)
-    idx = jnp.arange(n)
-    T = T.at[idx, idx].set(d)
-    T = T.at[idx[1:], idx[:-1]].set(e)
-    T = T.at[idx[:-1], idx[1:]].set(e)
-    return jnp.linalg.eigvalsh(T)
+    return jnp.linalg.eigvalsh(_assemble_tridiag(d, e))
 
 
 def steqr(d, e, Z: Optional[jax.Array] = None, opts=None):
     """Tridiagonal QR iteration with optional eigenvector accumulation
     (src/steqr.cc distributes the Z update; single-device XLA equivalent)."""
-    n = d.shape[-1]
-    T = jnp.zeros((n, n), dtype=d.dtype)
-    idx = jnp.arange(n)
-    T = T.at[idx, idx].set(d)
-    T = T.at[idx[1:], idx[:-1]].set(e)
-    T = T.at[idx[:-1], idx[1:]].set(e)
-    lam, Q = jnp.linalg.eigh(T)
+    lam, Q = jnp.linalg.eigh(_assemble_tridiag(d, e))
     if Z is not None:
         Q = jnp.matmul(Z.astype(Q.dtype) if Z.dtype != Q.dtype else Z, Q,
                        precision=lax.Precision.HIGHEST)

@@ -313,6 +313,15 @@ def getrf_tntpiv(A, opts=None):
 # ---------------------------------------------------------------------------
 
 
+def lu_factored_solve(plu, perm, rhs):
+    """Permute rows + unit-lower solve + upper solve from a packed LU factor —
+    the shared kernel of getrs, the *_mixed preconditioners, and gecondest."""
+    pb = jnp.take(rhs, perm, axis=0) if perm is not None else rhs
+    y = lax.linalg.triangular_solve(plu, pb, left_side=True, lower=True,
+                                    unit_diagonal=True)
+    return lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
+
+
 def getrs(LU, perm, B, opts=None, trans=False):
     """Solve A X = B from the LU factor (src/getrs.cc: permuteRows(Forward) +
     work::trsm(L) + work::trsm(U); here: one gather + two TriangularSolves)."""
@@ -326,11 +335,7 @@ def getrs(LU, perm, B, opts=None, trans=False):
                                         unit_diagonal=True, transpose_a=True)
         x = jnp.zeros_like(z).at[perm].set(z) if perm is not None else z
         return write_back(B, x)
-    pb = jnp.take(b, perm, axis=0) if perm is not None else b
-    y = lax.linalg.triangular_solve(lu_, pb, left_side=True, lower=True,
-                                    unit_diagonal=True)
-    x = lax.linalg.triangular_solve(lu_, y, left_side=True, lower=False)
-    return write_back(B, x)
+    return write_back(B, lu_factored_solve(lu_, perm, b))
 
 
 def gesv(A, B, opts=None):
@@ -378,10 +383,7 @@ def gesv_mixed(A, B, opts=None):
         info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
 
         def solve_lo(rhs):
-            pb = jnp.take(rhs.astype(lo), perm, axis=0)
-            y = lax.linalg.triangular_solve(plu, pb, left_side=True, lower=True,
-                                            unit_diagonal=True)
-            return lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
+            return lu_factored_solve(plu, perm, rhs.astype(lo))
 
         x, iters, converged = _ir_solve(a, b, solve_lo, opts)
 
@@ -448,10 +450,7 @@ def gesv_mixed_gmres(A, B, opts=None):
         info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
 
         def precond(r):
-            pb = jnp.take(r.astype(lo), perm, axis=0)
-            y = lax.linalg.triangular_solve(plu, pb[:, None], left_side=True,
-                                            lower=True, unit_diagonal=True)
-            z = lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
+            z = lu_factored_solve(plu, perm, r.astype(lo)[:, None])
             return z[:, 0].astype(b.dtype)
 
         def matvec(x):

@@ -243,7 +243,12 @@ def cholqr(A, opts=None):
                                              conjugate_a=True, transpose_a=True)
             R1 = jnp.conj(L.T)
         # CholeskyQR2: re-orthogonalize
-        Q2, R2, _ = one_pass(Q1)
+        Q2, R2, info2 = one_pass(Q1)
+        if int(info2) != 0:
+            # rank-deficient input: the Gram route cannot recover — fall back to
+            # Householder QR (the reference's MethodCholQR -> MethodGels::QR fallback)
+            Q, R = lax.linalg.qr(a, full_matrices=False)
+            return Q, R
         R = jnp.matmul(R2, R1, precision=lax.Precision.HIGHEST)
     return Q2, R
 

@@ -104,14 +104,11 @@ def ge2tb(A, opts=None):
     # For exact parity we compute the bidiagonal through jnp's internal
     # tridiagonalization of the Jordan-Wielandt form later; current form returns
     # the Golub-Kahan result computed by alternating Householder passes.
-    U = jnp.eye(m, k, dtype=a.dtype)
-    VT = jnp.eye(k, n, dtype=a.dtype)
-    B = a
     # alternating reflections, one column/row at a time (host-unrolled; stage is
     # O(mn^2) — parity scaffolding, the fused svd() path is the fast route)
     import numpy as np
 
-    Bh = np.array(B)
+    Bh = np.array(a)
     Uh = np.eye(m, dtype=Bh.dtype)
     Vh = np.eye(n, dtype=Bh.dtype)
     for j in range(k):
@@ -159,8 +156,13 @@ def ge2tb(A, opts=None):
 
 def tb2bd(band, kd, opts=None):
     """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc).  For the kd=1
-    output of ge2tb this is the identity extraction of (d, e)."""
+    output of ge2tb this is the identity extraction of (d, e); a wider band (kd > 1)
+    is re-bidiagonalized through the ge2tb Householder pass — correct for any kd,
+    with the O(n*kd) bulge chase tracked for a later round."""
     b = as_array(band)
+    if kd > 1:
+        d, e, _, _ = ge2tb(b, opts)
+        return d, e
     k = min(b.shape[-2:])
     d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))[:k]
     e = jnp.real(jnp.diagonal(b, offset=1, axis1=-2, axis2=-1))[: k - 1]

@@ -1,0 +1,20 @@
+"""Distributed execution layer — the TPU-native replacement for MPI + process grids.
+
+Reference analogue (SURVEY.md §2.6, §5.8): SLATE distributes tiles over a p×q MPI grid
+(func.hh:100-217) and moves them with hypercube tile broadcasts/reductions
+(BaseMatrix.hh:1999-2452, internal_comm.cc:72-123).  Here the process grid is a
+``jax.sharding.Mesh`` over the TPU slice, tile ownership is a ``NamedSharding``, and
+the tile collectives are XLA ICI collectives (`all_gather`, `psum`, `ppermute`,
+`psum_scatter`) — either inserted automatically by GSPMD when drivers run under ``jit``
+with sharded operands, or issued explicitly inside ``shard_map`` for the pipelined
+algorithms (SUMMA ring gemm, tall-skinny CholQR trees).
+"""
+
+from .mesh import ProcessGrid
+from .collectives import (axis_bcast, axis_allreduce, axis_reduce_scatter, ring_shift,
+                          axis_index)
+from .distribute import (block_spec, distribute, replicate, redistribute,
+                         cyclic_to_blocked, blocked_to_cyclic, cyclic_permutation)
+from .summa import gemm_distributed, gemm_allgather, gemm_ring, summa_gemm
+from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
+                      cholqr_distributed, gels_cholqr_distributed)

@@ -1,0 +1,76 @@
+"""Mesh-axis collective primitives used inside ``shard_map`` bodies.
+
+Reference analogue (SURVEY.md §5.8): SLATE's tile collectives — ``listBcast``
+(hypercube broadcast tree, BaseMatrix.hh:1999-2100 + internal_comm.cc:72-117),
+``listReduce`` (BaseMatrix.hh:2219-2258), pivot ``MPI_Bcast`` (getrf.cc:113-119) and
+maxloc allreduces (types.hh:161-175).
+
+On TPU the hand-built hypercube trees are unnecessary: ICI collectives are
+hardware-scheduled ring/torus algorithms, so each reference pattern maps to a single
+XLA collective:
+
+=====================  ==============================================
+reference pattern      TPU-native primitive
+=====================  ==============================================
+listBcast (root tile)  ``axis_bcast`` (psum of masked contribution)
+panel gather           ``lax.all_gather`` along the mesh axis
+listReduce             ``axis_allreduce`` / ``axis_reduce_scatter``
+pivot maxloc           ``lax.pmax`` + index arithmetic (see lu)
+ring/lookahead bcast   ``ring_shift`` (ppermute)
+=====================  ==============================================
+
+These helpers are *SPMD-internal*: they must be called inside ``shard_map`` (or pmap)
+with the named axis in scope.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    """This shard's coordinate along the axis (the reference's rank-in-communicator)."""
+    return lax.axis_index(axis_name)
+
+
+def axis_bcast(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Broadcast ``x`` from the shard at ``root`` to every shard along ``axis_name``.
+
+    The listBcast analogue.  Implemented as a masked psum — one ICI all-reduce, which
+    on TPU is as fast as a tree broadcast and needs no per-tile tag bookkeeping
+    (BaseMatrix.hh:2129-2216's multithreaded tags disappear in SPMD program order).
+    """
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def axis_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
+    """listReduce analogue: elementwise reduce across the axis, result everywhere."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def axis_reduce_scatter(x: jax.Array, axis_name: str, scatter_dim: int = 0) -> jax.Array:
+    """Reduce across the axis, scattering the result (listReduce where each rank keeps
+    its own destination tiles)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim, tiled=True)
+
+
+def ring_shift(x: jax.Array, axis_name: str, shift: int = 1, size: int | None = None):
+    """Rotate shards along the axis by ``shift`` (SUMMA/Cannon pipeline step;
+    the TPU-native form of the reference's lookahead panel sends).
+
+    ``size`` is the axis size; required because ppermute needs a static permutation.
+    """
+    if size is None:
+        size = lax.axis_size(axis_name)
+    perm = [(i, (i - shift) % size) for i in range(size)]
+    return lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,88 @@
+"""Distributing matrices over a ProcessGrid, and moving them between distributions.
+
+Reference analogue: the tile→rank block-cyclic maps (func.hh:100-217) applied at
+matrix construction (MatrixStorage.hh:494-499), plus ``slate::redistribute``
+(src/redistribute.cc:1-154) which migrates a matrix tile-by-tile between two
+distributions with send/recv.
+
+TPU re-design: XLA's ``NamedSharding`` gives *block-contiguous* layouts natively.
+2D **block-cyclic** ownership (tile (i,j) → rank (i%p, j%q)) is realized by composing a
+block layout with a tile permutation: permuting block-rows so that rows owned by mesh
+row r become contiguous turns cyclic ownership into a plain block sharding.  The
+permutation is itself a gather executed on-device, so `cyclic_to_blocked` +
+``distribute`` is the constructor path and ``redistribute`` between any two layouts is
+a single ``device_put`` (XLA emits the minimal ICI all-to-all — the reference's
+tile-by-tile isend/irecv loop collapses into one collective).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ..core.exceptions import slate_assert
+from .mesh import ProcessGrid
+
+
+def block_spec(grid: ProcessGrid, row_shard: bool = True,
+               col_shard: bool = True) -> NamedSharding:
+    """Plain 2-D block sharding: rows over p, cols over q."""
+    return grid.spec(row_shard, col_shard)
+
+
+def distribute(a: jax.Array, grid: ProcessGrid, row_shard: bool = True,
+               col_shard: bool = True) -> jax.Array:
+    """Place ``a`` on the grid with a block layout (the default compiled-path layout)."""
+    return jax.device_put(a, grid.spec(row_shard, col_shard))
+
+
+def replicate(a: jax.Array, grid: ProcessGrid) -> jax.Array:
+    return jax.device_put(a, grid.replicated())
+
+
+def redistribute(a: jax.Array, dst: NamedSharding) -> jax.Array:
+    """Move an array (however currently sharded) to ``dst``
+    (src/redistribute.cc — one device_put instead of a send/recv loop)."""
+    return jax.device_put(a, dst)
+
+
+def cyclic_permutation(n: int, nb: int, nparts: int) -> np.ndarray:
+    """Element permutation turning block-cyclic tile ownership into contiguous blocks.
+
+    Returns ``perm`` such that ``a[perm]`` groups all rows of tiles owned by part 0
+    first, then part 1, …  With ragged edges the parts are *unequal*, so callers pad
+    to ``num_tiles`` divisible shapes before sharding (the compiled drivers already
+    pad to uniform nb — SURVEY.md §7 hard-part 5).
+    """
+    slate_assert(n % nb == 0, "cyclic_permutation requires tile-aligned n (pad first)")
+    nt = n // nb
+    order = []
+    for part in range(nparts):
+        for t in range(part, nt, nparts):
+            order.extend(range(t * nb, (t + 1) * nb))
+    return np.array(order, dtype=np.int64)
+
+
+def cyclic_to_blocked(a: jax.Array, grid: ProcessGrid, nb: int) -> jax.Array:
+    """Permute a matrix so 2D block-cyclic (nb-tile) ownership becomes the block
+    layout of ``grid.spec()`` — the bridge from ScaLAPACK-style cyclic semantics to
+    XLA shardings (the ``fromScaLAPACK`` constructor path, Matrix.hh:347)."""
+    m, n = a.shape[-2:]
+    rp = jnp.asarray(cyclic_permutation(m, nb, grid.p))
+    cp = jnp.asarray(cyclic_permutation(n, nb, grid.q))
+    return a[..., rp, :][..., :, cp]
+
+
+def blocked_to_cyclic(a: jax.Array, grid: ProcessGrid, nb: int) -> jax.Array:
+    """Inverse of :func:`cyclic_to_blocked`."""
+    m, n = a.shape[-2:]
+    rp = cyclic_permutation(m, nb, grid.p)
+    cp = cyclic_permutation(n, nb, grid.q)
+    rinv = jnp.asarray(np.argsort(rp))
+    cinv = jnp.asarray(np.argsort(cp))
+    return a[..., rinv, :][..., :, cinv]

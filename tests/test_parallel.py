@@ -1,0 +1,166 @@
+"""Distributed-layer tests on the virtual 8-device CPU mesh (SURVEY.md §4: the
+reference tests MPI with ``mpirun -np 4`` on one node; we test SPMD with 8 virtual
+devices — same code path as a real pod slice, small world size)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from slate_tpu.parallel import (
+    ProcessGrid, blocked_to_cyclic, cholqr_distributed, cyclic_to_blocked,
+    distribute, gels_cholqr_distributed, gemm_allgather, gemm_distributed,
+    gemm_ring, posv_distributed, potrf_distributed, redistribute,
+    trsm_distributed)
+
+
+@pytest.fixture(scope="module")
+def grid24():
+    return ProcessGrid(2, 4)
+
+
+@pytest.fixture(scope="module")
+def grid22():
+    return ProcessGrid(2, 2, devices=jax.devices()[:4])
+
+
+def _spd(rng, n, dtype=jnp.float64):
+    a = rng.standard_normal((n, n))
+    return jnp.asarray(a @ a.T + n * np.eye(n), dtype=dtype)
+
+
+class TestGrid:
+    def test_shape_and_devices(self, grid24):
+        assert (grid24.p, grid24.q) == (2, 4)
+        assert grid24.size == 8
+        assert grid24.mesh.devices.shape == (2, 4)
+
+    def test_coords_col_order(self, grid24):
+        # Col order: rank = i + j*p (func.hh:178-186)
+        assert grid24.coords(0) == (0, 0)
+        assert grid24.coords(1) == (1, 0)
+        assert grid24.coords(2) == (0, 1)
+
+    def test_tile_rank_matches_grid(self, grid24):
+        assert grid24.tile_rank(0, 0) == 0
+        assert grid24.tile_rank(1, 0) == 1
+        assert grid24.tile_rank(0, 1) == 2
+
+
+class TestDistribute:
+    def test_block_sharding_placement(self, grid24, rng):
+        a = jnp.asarray(rng.standard_normal((16, 16)))
+        d = distribute(a, grid24)
+        assert len(d.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(d), np.asarray(a))
+
+    def test_cyclic_roundtrip(self, grid24, rng):
+        a = jnp.asarray(rng.standard_normal((16, 32)))
+        c = cyclic_to_blocked(a, grid24, nb=4)
+        back = blocked_to_cyclic(c, grid24, nb=4)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(a))
+
+    def test_cyclic_groups_tiles(self, grid24, rng):
+        # with m=16 nb=4 p=2: row-tiles 0,2 -> part 0; 1,3 -> part 1
+        a = jnp.arange(16.0)[:, None] * jnp.ones((1, 8))
+        c = cyclic_to_blocked(a, grid24, nb=4)
+        rows = np.asarray(c[:, 0]).astype(int)
+        assert list(rows[:8]) == [0, 1, 2, 3, 8, 9, 10, 11]
+
+    def test_redistribute(self, grid24, rng):
+        a = distribute(jnp.asarray(rng.standard_normal((16, 16))), grid24)
+        r = redistribute(a, grid24.replicated())
+        np.testing.assert_allclose(np.asarray(r), np.asarray(a))
+
+
+class TestSumma:
+    def test_allgather_matches_matmul(self, grid24, rng):
+        a = jnp.asarray(rng.standard_normal((16, 24)))
+        b = jnp.asarray(rng.standard_normal((24, 32)))
+        c = gemm_allgather(a, b, grid24)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                                   rtol=1e-12)
+        assert len(c.sharding.device_set) == 8
+
+    def test_ring_matches_matmul(self, grid22, rng):
+        a = jnp.asarray(rng.standard_normal((8, 12)))
+        b = jnp.asarray(rng.standard_normal((12, 16)))
+        c = gemm_ring(a, b, grid22)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                                   rtol=1e-12)
+
+    def test_dispatch_auto(self, grid22, rng):
+        a = jnp.asarray(rng.standard_normal((8, 16)))
+        b = jnp.asarray(rng.standard_normal((16, 8)))
+        c = gemm_distributed(a, b, grid22)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                                   rtol=1e-12)
+
+    def test_complex(self, grid22, rng):
+        a = jnp.asarray(rng.standard_normal((8, 8)) + 1j * rng.standard_normal((8, 8)))
+        b = jnp.asarray(rng.standard_normal((8, 8)) + 1j * rng.standard_normal((8, 8)))
+        for fn in (gemm_allgather, gemm_ring):
+            c = fn(a, b, grid22)
+            np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                                       rtol=1e-12)
+
+
+class TestDistributedSolvers:
+    def test_potrf_residual(self, grid24, rng):
+        n = 64
+        A = _spd(rng, n)
+        L = potrf_distributed(A, grid24, nb=16)
+        Lh = np.asarray(L)
+        res = np.linalg.norm(Lh @ Lh.T - np.asarray(A)) / np.linalg.norm(np.asarray(A))
+        assert res < 1e-12
+        assert len(L.sharding.device_set) == 8
+
+    def test_posv_solves(self, grid24, rng):
+        n, nrhs = 32, 8
+        A = _spd(rng, n)
+        X_true = jnp.asarray(rng.standard_normal((n, nrhs)))
+        B = A @ X_true
+        X = posv_distributed(A, B, grid24, nb=8)
+        np.testing.assert_allclose(np.asarray(X), np.asarray(X_true), rtol=1e-8)
+
+    def test_posv_ragged_shapes(self, grid24, rng):
+        # n and nrhs that do NOT divide the grid: pad-and-slice path
+        n, nrhs = 23, 3
+        A = _spd(rng, n)
+        X_true = jnp.asarray(rng.standard_normal((n, nrhs)))
+        B = A @ X_true
+        X = posv_distributed(A, B, grid24, nb=8)
+        np.testing.assert_allclose(np.asarray(X), np.asarray(X_true), rtol=1e-8)
+
+    def test_trsm(self, grid24, rng):
+        n = 32
+        L = jnp.asarray(np.tril(rng.standard_normal((n, n))) + n * np.eye(n))
+        B = jnp.asarray(rng.standard_normal((n, 16)))
+        X = trsm_distributed(L, B, grid24)
+        np.testing.assert_allclose(np.asarray(L @ X), np.asarray(B), rtol=1e-10)
+
+
+class TestCholQR:
+    def test_qr_tall(self, grid24, rng):
+        m, n = 128, 16
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        Q, R = cholqr_distributed(A, grid24)
+        Qh, Rh = np.asarray(Q), np.asarray(R)
+        np.testing.assert_allclose(Qh @ Rh, np.asarray(A), rtol=1e-10)
+        np.testing.assert_allclose(Qh.T @ Qh, np.eye(n), atol=1e-10)
+        assert np.allclose(np.tril(Rh, -1), 0)
+
+    def test_qr_ragged_rows(self, grid24, rng):
+        m, n = 61, 7
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        Q, R = cholqr_distributed(A, grid24)
+        np.testing.assert_allclose(np.asarray(Q) @ np.asarray(R), np.asarray(A),
+                                   rtol=1e-9)
+
+    def test_gels(self, grid24, rng):
+        m, n, nrhs = 64, 8, 4
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        X_true = jnp.asarray(rng.standard_normal((n, nrhs)))
+        B = A @ X_true
+        X = gels_cholqr_distributed(A, B, grid24)
+        np.testing.assert_allclose(np.asarray(X), np.asarray(X_true), rtol=1e-8)

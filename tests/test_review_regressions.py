@@ -1,0 +1,37 @@
+"""Regressions for correctness findings from code review: rank-deficient CholQR,
+wide-band hb2st/tb2bd inputs (previously silently wrong)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as slate
+
+
+def test_cholqr_rank_deficient_falls_back(rng):
+    # exactly dependent column: Gram route fails; must fall back to Householder QR,
+    # not return NaN (the reference's CholQR -> QR fallback)
+    a = rng.standard_normal((40, 6))
+    a[:, 5] = a[:, 0] + a[:, 1]
+    Q, R = slate.cholqr(a)
+    assert np.isfinite(np.asarray(Q)).all()
+    np.testing.assert_allclose(np.asarray(Q) @ np.asarray(R), a, atol=1e-10)
+
+
+def test_hb2st_bandwidth_two(rng):
+    n = 8
+    B = np.zeros((n, n))
+    for off in (0, 1, 2):
+        v = rng.standard_normal(n - off)
+        B += np.diag(v, -off) + (np.diag(v, off) if off else 0)
+    d, e = slate.hb2st(B)
+    lam = np.sort(np.asarray(slate.sterf(d, e)))
+    np.testing.assert_allclose(lam, np.linalg.eigvalsh(B), atol=1e-10)
+
+
+def test_tb2bd_kd_two(rng):
+    T = np.triu(rng.standard_normal((5, 5)))
+    T[np.triu_indices(5, 3)] = 0  # upper band, kd = 2
+    d, e = slate.tb2bd(T, kd=2)
+    s, _, _ = slate.bdsqr(d, e)
+    np.testing.assert_allclose(np.sort(np.asarray(s))[::-1],
+                               np.linalg.svd(T, compute_uv=False), atol=1e-10)
