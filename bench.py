@@ -1,0 +1,69 @@
+"""Headline benchmark: gemm GFLOP/s on one chip (BASELINE.json config #1,
+"dgemm n=4096 nb=256, 1x1 grid" — examples/ex05_blas.cc / test_gemm in the reference).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Precision envelope: the reference's headline is double precision on GPU; TPU has no
+f64 ALUs, so the comparable configuration is f32 accumulation with
+``Precision.HIGHEST`` (6-pass bf16 emulation — the dtype the z/d routine family maps
+to on TPU, SURVEY.md §7 hard-part 6).  ``vs_baseline`` divides by 15,000 GFLOP/s — a
+measured cuBLAS A100 dgemm figure at n=4096, the reference's native configuration —
+so >1.0 beats the reference hardware's double-precision rate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BASELINE_GFLOPS = 15_000.0  # cuBLAS dgemm n=4096 on A100 (reference-native config)
+
+
+def _time_chain(a, b, k: int, precision, repeats: int = 3) -> float:
+    """Best wall time of one jitted call running k chained matmuls."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(a.shape[-1], a.dtype))
+
+    def body(i, c):
+        return jnp.matmul(c, b, precision=precision) * scale
+
+    fn = jax.jit(lambda a: lax.fori_loop(0, k, body, a))
+    fn(a).block_until_ready()  # compile + warm up
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        fn(a + jnp.asarray(i, a.dtype)).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def bench_gemm(n: int = 4096, dtype=jnp.float32, precision=lax.Precision.HIGHEST,
+               k_small: int = 8, k_large: int = 136):
+    """Compute-only GFLOP/s via a chain-length delta: timing (k_large - k_small)
+    extra matmuls inside one jit call cancels dispatch/transfer overhead (the
+    tunnel round-trip here is ~70 ms — larger than a single n=4096 matmul)."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=dtype)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), dtype=dtype)
+
+    t_small = _time_chain(a, b, k_small, precision)
+    t_large = _time_chain(a, b, k_large, precision)
+    per_matmul = (t_large - t_small) / (k_large - k_small)
+    return 2.0 * n**3 / per_matmul / 1e9
+
+
+def main():
+    gflops = bench_gemm()
+    print(json.dumps({
+        "metric": "gemm_f32hi_n4096_gflops",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / BASELINE_GFLOPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

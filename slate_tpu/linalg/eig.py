@@ -161,14 +161,22 @@ def hb2st(band, opts=None):
     b = as_array(band)
     n = b.shape[-1]
     idx = jnp.arange(n)
-    # detect content beyond the first sub/superdiagonal (band is stored dense)
-    beyond = jnp.tril(b, -2)
-    if n > 2 and bool(jnp.any(jnp.abs(beyond) > 0)):
-        full = jnp.tril(b) + jnp.conj(jnp.swapaxes(jnp.tril(b, -1), -1, -2))
+    # detect content beyond the first sub/superdiagonal in EITHER triangle — the
+    # band may be lower- or upper-stored (HermitianBandMatrix supports both uplos)
+    wide_lower = n > 2 and bool(jnp.any(jnp.abs(jnp.tril(b, -2)) > 0))
+    wide_upper = n > 2 and bool(jnp.any(jnp.abs(jnp.triu(b, 2)) > 0))
+    if wide_lower or wide_upper:
+        if wide_lower:
+            full = jnp.tril(b) + jnp.conj(jnp.swapaxes(jnp.tril(b, -1), -1, -2))
+        else:
+            full = jnp.triu(b) + jnp.conj(jnp.swapaxes(jnp.triu(b, 1), -1, -2))
         _, d, e, _ = lax.linalg.tridiagonal(full, lower=True)
         return jnp.real(d), jnp.abs(e)
     d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))
     e_c = b[..., idx[1:], idx[:-1]]
+    # an upper-stored tridiagonal band keeps its offdiagonal in the superdiagonal
+    e_up = b[..., idx[:-1], idx[1:]]
+    e_c = jnp.where(jnp.abs(e_c) > 0, e_c, jnp.conj(e_up))
     # rotate away complex phases on the subdiagonal (the unitary diagonal similarity
     # the reference's bulge-chasing accumulates into V)
     e = jnp.abs(e_c)

@@ -29,6 +29,20 @@ from ..core.exceptions import slate_assert
 from .mesh import ProcessGrid
 
 
+def ceil_mult(x: int, mult: int) -> int:
+    """Round up to a multiple — the shared edge policy (pad-and-mask, SURVEY.md §7)."""
+    return -(-x // mult) * mult
+
+
+def pad2d(a: jax.Array, row_mult: int = 1, col_mult: int = 1) -> jax.Array:
+    """Zero-pad the trailing 2-D dims up to multiples (no-op when already aligned)."""
+    m, n = a.shape[-2:]
+    pm, pn = ceil_mult(m, row_mult), ceil_mult(n, col_mult)
+    if (pm, pn) == (m, n):
+        return a
+    return jnp.pad(a, [(0, 0)] * (a.ndim - 2) + [(0, pm - m), (0, pn - n)])
+
+
 def block_spec(grid: ProcessGrid, row_shard: bool = True,
                col_shard: bool = True) -> NamedSharding:
     """Plain 2-D block sharding: rows over p, cols over q."""

@@ -45,8 +45,8 @@ class ProcessGrid:
             p = len(devices) // q
         elif q is None:
             q = len(devices) // p
-        slate_assert(p * q <= len(devices),
-                     f"grid {p}x{q} needs {p*q} devices, have {len(devices)}")
+        slate_assert(p >= 1 and q >= 1 and p * q <= len(devices),
+                     f"grid {p}x{q} needs p, q >= 1 and p*q <= {len(devices)} devices")
         self.p, self.q = int(p), int(q)
         self.order = GridOrder.from_string(order)
         dev_grid = np.array(devices[:p * q])

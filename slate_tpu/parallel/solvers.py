@@ -74,14 +74,14 @@ def _lcm(a: int, b: int) -> int:
 def _pad_spd(Af: jax.Array, mult: int):
     """Pad a Hermitian matrix to a mult-divisible size with an identity tail, so the
     padded matrix stays SPD (the pad-and-mask edge policy, SURVEY.md §7 hard-part 5)."""
+    from .distribute import pad2d
+
     n = Af.shape[-1]
-    np_ = -(-n // mult) * mult
-    if np_ == n:
+    Af2 = pad2d(Af, mult, mult)
+    if Af2.shape[-1] == n:
         return Af, n
-    pad = np_ - n
-    Af = jnp.pad(Af, ((0, pad), (0, pad)))
-    idx = jnp.arange(n, np_)
-    return Af.at[idx, idx].set(1), n
+    idx = jnp.arange(n, Af2.shape[-1])
+    return Af2.at[idx, idx].set(1), n
 
 
 def potrf_distributed(Af: jax.Array, grid: ProcessGrid, nb: int = 256) -> jax.Array:
@@ -110,12 +110,16 @@ def trsm_distributed(L: jax.Array, B: jax.Array, grid: ProcessGrid,
     """Distributed left triangular solve (work::trsm analogue); XLA's blocked
     TriangularSolve partitions over the sharded RHS.  Ragged shapes are padded:
     L gets an identity tail (keeps it invertible), B zero rows/cols."""
+    from .distribute import pad2d
+
     n, nrhs = B.shape[-2:]
     mult = _lcm(grid.p, grid.q)
     Lp, _ = _pad_spd(L, mult)
     npad = Lp.shape[-1]
-    cpad = -(-nrhs // grid.q) * grid.q
-    Bp = jnp.pad(B, ((0, npad - n), (0, cpad - nrhs)))
+    Bp = pad2d(B, 1, grid.q)
+    if npad > n:
+        Bp = jnp.pad(Bp, ((0, npad - n), (0, 0)))
+    cpad = Bp.shape[-1]
     Lp = jax.device_put(Lp, grid.spec())
     Bp = jax.device_put(Bp, grid.spec())
     X = _trsm_dist_fn(grid.mesh, lower, conj_trans, str(Lp.dtype))(Lp, Bp)
@@ -160,13 +164,21 @@ def cholqr_distributed(A: jax.Array, grid: ProcessGrid,
     The psum of Gram contributions is the reference's listReduce tree
     (BaseMatrix.hh:2219-2258) collapsed into one ICI all-reduce.
     """
+    from .distribute import pad2d
+
     m, n = A.shape[-2:]
     world = grid.size
     slate_assert(m >= n, "cholqr expects a tall matrix")
-    mpad = -(-m // world) * world
-    Ap = jnp.pad(A, ((0, mpad - m), (0, 0)))  # zero rows leave the Gram unchanged
+    Ap = pad2d(A, world, 1)  # zero rows leave the Gram unchanged
+    mpad = Ap.shape[-2]
     Ap = jax.device_put(Ap, grid.row_spec())
     Q, R = _cholqr_fn(grid.mesh, precision)(Ap)
+    if not bool(jnp.isfinite(jnp.diagonal(R)).all()):
+        # rank-deficient input: the Gram route cannot recover — fall back to
+        # Householder QR on the gathered matrix (mirrors linalg/qr.py cholqr)
+        Qf, Rf = jnp.linalg.qr(jax.device_put(pad2d(A, world, 1), grid.replicated()))
+        Qf = jax.device_put(Qf, grid.row_spec())
+        return (Qf[:m] if mpad != m else Qf), Rf
     return (Q[:m] if mpad != m else Q), R
 
 

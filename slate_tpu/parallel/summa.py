@@ -134,16 +134,14 @@ def summa_gemm(alpha, A, B, beta, C, opts=None, grid: ProcessGrid | None = None)
     hard-part 5).
     """
     from ..core.matrix import as_array
+    from .distribute import pad2d
 
     grid = grid or ProcessGrid()
     a, b, c = as_array(A), as_array(B), as_array(C)
-    m, k = a.shape[-2:]
-    n = b.shape[-1]
-    pm = -(-m // grid.p) * grid.p
-    pk = -(-k // (grid.p * grid.q)) * grid.p * grid.q
-    pn = -(-n // grid.q) * grid.q
-    ap = jnp.pad(a, ((0, pm - m), (0, pk - k)))
-    bp = jnp.pad(b, ((0, pk - k), (0, pn - n)))
+    m, n = a.shape[-2], b.shape[-1]
+    kmult = grid.p * grid.q
+    ap = pad2d(a, grid.p, kmult)
+    bp = pad2d(b, kmult, grid.q)
     prod = gemm_distributed(ap, bp, grid)[:m, :n]
     return alpha * prod + beta * c
 

@@ -28,6 +28,35 @@ def test_hb2st_bandwidth_two(rng):
     np.testing.assert_allclose(lam, np.linalg.eigvalsh(B), atol=1e-10)
 
 
+def test_hb2st_upper_stored_band(rng):
+    # upper-stored Hermitian band (content in superdiagonals only) must not be
+    # silently treated as diagonal
+    n = 8
+    full = np.zeros((n, n))
+    for off in (0, 1, 2):
+        v = rng.standard_normal(n - off)
+        full += np.diag(v, -off) + (np.diag(v, off) if off else 0)
+    upper = np.triu(full)
+    d, e = slate.hb2st(upper)
+    lam = np.sort(np.asarray(slate.sterf(d, e)))
+    np.testing.assert_allclose(lam, np.linalg.eigvalsh(full), atol=1e-10)
+
+
+def test_distributed_cholqr_rank_deficient(rng):
+    from slate_tpu.parallel import ProcessGrid, cholqr_distributed
+    a = rng.standard_normal((40, 6))
+    a[:, 5] = a[:, 0] + a[:, 1]
+    Q, R = cholqr_distributed(np.asarray(a), ProcessGrid())
+    assert np.isfinite(np.asarray(Q)).all()
+    np.testing.assert_allclose(np.asarray(Q) @ np.asarray(R), a, atol=1e-10)
+
+
+def test_process_grid_rejects_zero_dim():
+    from slate_tpu.parallel import ProcessGrid
+    with pytest.raises(slate.SlateError):
+        ProcessGrid(q=16)  # p would be 8//16 == 0
+
+
 def test_tb2bd_kd_two(rng):
     T = np.triu(rng.standard_normal((5, 5)))
     T[np.triu_indices(5, 3)] = 0  # upper band, kd = 2
