@@ -22,12 +22,14 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
 
 from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
                    scale_row_col, set, symm, syr2k, syrk, trmm, trsm)
-from .linalg import (bdsqr, cholqr, ge2tb, gecondest, gelqf, gels, geqrf, gerbt,
-                     gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
-                     getrf, getrf_nopiv, getrf_tntpiv, getri, getrs, hb2st, he2hb,
-                     heev, hegst, hegv, norm1est, pocondest, posv, posv_mixed,
-                     potrf, potri, potrs, stedc, steqr, sterf, svd, svd_vals,
-                     tb2bd, trcondest, trtri, trtrm, unmlq, unmqr)
+from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
+                     gelqf, gels, geqrf, gerbt, gesv, gesv_mixed,
+                     gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf, getrf_nopiv,
+                     getrf_tntpiv, getri, getrs, hb2st, hbmm, he2hb, heev, hegst,
+                     hegv, norm1est, pbsv, pbtrf, pbtrs, pocondest, posv,
+                     posv_mixed, potrf, potri, potrs, stedc, steqr, sterf, svd,
+                     svd_vals, tb2bd, tbsm, trcondest, trtri, trtrm, unmlq,
+                     unmqr)
 try:
     # distributed layer needs jax.shard_map / NamedSharding; single-device use of
     # the library must survive without it (blas.py raises a clear SlateError if a

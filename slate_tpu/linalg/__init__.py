@@ -8,3 +8,5 @@ from .qr import (TriangularFactors, cholqr, gelqf, gels, geqrf, tsqr, unmlq, unm
 from .eig import (hb2st, he2hb, heev, hegst, hegv, stedc, steqr, sterf)
 from .svd import bdsqr, ge2tb, svd, svd_vals, tb2bd
 from .condest import gecondest, norm1est, pocondest, trcondest
+from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
+                   tbsm)
