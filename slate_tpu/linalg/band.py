@@ -31,15 +31,15 @@ TPU re-design:
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..core.exceptions import SlateError, slate_assert
-from ..core.matrix import BaseBandMatrix, BaseMatrix, as_array, write_back
-from ..core.types import Diag, Norm, Options, Side, Uplo
+from ..core.matrix import BaseBandMatrix, as_array, write_back
+from ..core.types import Diag, Options, Side, Uplo
 from ..utils.trace import trace_block
 from .lu import _lu_info
 
@@ -119,7 +119,10 @@ def _gbmm_fn(m: int, k: int, kl: int, ku: int, nb: int, dtype_str: str):
 
 
 def gbmm(alpha, A, B, beta, C, opts=None, kl=None, ku=None):
-    """C = alpha op(A) B + beta C with A a general band matrix (src/gbmm.cc)."""
+    """C = alpha A B + beta C with A a general band matrix (src/gbmm.cc).
+
+    op(A) is expressed through transposed BandMatrix views (``A.T.array`` with
+    swapped kl/ku); raw arrays are taken as-is."""
     opts = Options.make(opts)
     a, kl, ku = _band_meta(A, kl, ku)
     b, c = as_array(B), as_array(C)
@@ -157,6 +160,11 @@ def hbmm(side, alpha, A, B, beta, C, opts=None, uplo=None, kd=None):
     tri = tri * _band_mask(n, n, kd_v if u == Uplo.Lower else 0,
                            0 if u == Uplo.Lower else kd_v, a.dtype)
     strict = jnp.tril(tri, -1) if u == Uplo.Lower else jnp.triu(tri, 1)
+    if jnp.iscomplexobj(tri):
+        # Hermitian storage convention: imaginary part of the diagonal is not
+        # referenced (matches HermitianMatrix.full_array)
+        idx = jnp.arange(n)
+        tri = tri.at[idx, idx].set(jnp.real(tri[idx, idx]).astype(tri.dtype))
     full = tri + jnp.conj(jnp.swapaxes(strict, -1, -2))
     return gbmm(alpha, full, B, beta, C, opts, kl=kd_v, ku=kd_v)
 
@@ -202,22 +210,24 @@ def _tbsm_fn(n: int, kd: int, nb: int, nrhs: int, lower: bool, unit: bool,
             b = lax.dynamic_update_slice(b, x_k, (k0, 0))
             # windowed trailing update: the kdt block rows after (before) k
             if fwd:
-                off = lax.dynamic_slice(a, (k0 + nb, k0), (w, nb))
                 if trans:
                     off = lax.dynamic_slice(a, (k0, k0 + nb), (nb, w))
                     off = jnp.conj(jnp.swapaxes(off, -1, -2)) if dtype_str.startswith(
                         "complex") else jnp.swapaxes(off, -1, -2)
+                else:
+                    off = lax.dynamic_slice(a, (k0 + nb, k0), (w, nb))
                 tail = lax.dynamic_slice(b, (k0 + nb, 0), (w, nrhs))
                 tail = tail - jnp.matmul(off, x_k, precision=lax.Precision.HIGHEST)
                 b = lax.dynamic_update_slice(b, tail, (k0 + nb, 0))
             else:
                 # backward: update the kdt block rows above k; shift window so it
                 # stays in-bounds (rows [max(k0-w,0) .. k0))
-                a_sl = lax.dynamic_slice(a, (jnp.maximum(k0 - w, 0), k0), (w, nb))
                 if trans:
                     a_sl = lax.dynamic_slice(a, (k0, jnp.maximum(k0 - w, 0)), (nb, w))
                     a_sl = jnp.conj(jnp.swapaxes(a_sl, -1, -2)) if dtype_str.startswith(
                         "complex") else jnp.swapaxes(a_sl, -1, -2)
+                else:
+                    a_sl = lax.dynamic_slice(a, (jnp.maximum(k0 - w, 0), k0), (w, nb))
                 head = lax.dynamic_slice(b, (jnp.maximum(k0 - w, 0), 0), (w, nrhs))
                 upd = head - jnp.matmul(a_sl, x_k, precision=lax.Precision.HIGHEST)
                 # rows that slid past 0 must not be touched: re-mask
@@ -260,6 +270,13 @@ def tbsm(side, alpha, A, B, opts=None, uplo=None, diag=None, trans=False,
     if pivots is not None:
         slate_assert(u == Uplo.Lower and not trans,
                      "pivots only apply to the forward lower sweep (gbtrs)")
+        if isinstance(pivots, BandLU):  # carries its own factor-time nb/kl
+            nb, kd_v, pivots = pivots.nb, pivots.kl, pivots.perms
+        klt = max(1, _ceil_div(kd_v, nb))
+        slate_assert(pivots.shape[-1] == (klt + 1) * nb,
+                     f"pivot window {pivots.shape[-1]} does not match "
+                     f"kd={kd_v}, nb={nb} (pass the BandLU, or the block_size "
+                     "used at factorization time)")
         x = _gbtrs_forward(a, pivots, b, kd_v, nb)
     else:
         x = _tbsm_fn(n, kd_v, nb, b.shape[-1], u == Uplo.Lower,
