@@ -26,10 +26,10 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
                      gelqf, gels, geqrf, gerbt, gesv, gesv_mixed,
                      gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf, getrf_nopiv,
                      getrf_tntpiv, getri, getrs, hb2st, hbmm, he2hb, heev, hegst,
-                     hegv, norm1est, pbsv, pbtrf, pbtrs, pocondest, posv,
-                     posv_mixed, potrf, potri, potrs, stedc, steqr, sterf, svd,
-                     svd_vals, tb2bd, tbsm, trcondest, trtri, trtrm, unmlq,
-                     unmqr)
+                     hegv, hesv, hetrf, hetrs, norm1est, pbsv, pbtrf, pbtrs,
+                     pocondest, posv, posv_mixed, potrf, potri, potrs, stedc,
+                     steqr, sterf, svd, svd_vals, sysv, sytrf, sytrs, tb2bd,
+                     tbsm, trcondest, trtri, trtrm, unmlq, unmqr)
 try:
     # distributed layer needs jax.shard_map / NamedSharding; single-device use of
     # the library must survive without it (blas.py raises a clear SlateError if a

@@ -10,3 +10,5 @@ from .svd import bdsqr, ge2tb, svd, svd_vals, tb2bd
 from .condest import gecondest, norm1est, pocondest, trcondest
 from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
                    tbsm)
+from .indefinite import (HermitianFactors, hesv, hetrf, hetrs, sysv, sytrf,
+                         sytrs)

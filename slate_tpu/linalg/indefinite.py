@@ -1,0 +1,184 @@
+"""Hermitian/symmetric-indefinite solvers: hetrf / hetrs / hesv (+ sy* aliases).
+
+Reference analogue (SURVEY.md §2.4): ``src/{hetrf,hetrs,hesv}.cc`` — SLATE factors
+indefinite Hermitian systems with a communication-avoiding **blocked Aasen**
+algorithm: P A P^H = L T L^H where L is unit lower triangular (first block column =
+identity) and T is a Hermitian **band** matrix of bandwidth nb, which is then solved
+with the band LU (the reference routes hetrs through its banded solvers; same here
+via :func:`~slate_tpu.linalg.band.gbsv`).
+
+TPU re-design:
+
+* The per-panel work is expressed as a few large gemms: the Aasen H-column
+  H[:,j] = T[:, :j+1] @ L[j, :j+1]^H is ONE matmul against the dense-stored band T,
+  and the panel residual W = A[j+1:, j] - L @ H - L[:,j] @ H[j,j] is two more — all
+  MXU-shaped, no scalar recurrences.
+* Panel pivoting uses ``lax.linalg.lu`` on the tall residual panel (the reference's
+  multithreaded getrf panel team, SURVEY.md §2.6 "panel parallelism", becomes XLA's
+  blocked LU); the permutation is applied two-sidedly to the trailing matrix and to
+  the already-computed L rows, giving the standard Aasen P A P^H = L T L^H.
+* Ragged n is padded to whole blocks with an identity diagonal (pad-and-mask,
+  SURVEY.md §7 hard-part 5) — blockdiag(A, I) factors compatibly and the padded
+  solution rows are discarded.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import as_array, write_back
+from ..core.types import Options
+from ..utils.trace import trace_block
+from .band import BandLU, gbtrf, gbtrs
+from .eig import _full_herm
+
+__all__ = ["HermitianFactors", "hetrf", "hetrs", "hesv", "sytrf", "sytrs", "sysv"]
+
+
+class HermitianFactors(NamedTuple):
+    """Aasen factored form P A P^H = L T L^H (the reference's (A, pivots, T, H)
+    output bundle of hetrf, slate.hh hetrf signature). T is kept both as the
+    dense-stored band (for reconstruction/tests) and pre-factored by band LU so
+    repeated hetrs calls don't refactor (factor-once / solve-many contract)."""
+    L: jax.Array       # (n, n) unit lower triangular, first block column = identity
+    T: jax.Array       # (n, n) dense-stored Hermitian band, bandwidth nb
+    T_fac: BandLU      # band LU of T (bandwidths kl = ku = nb)
+    perm: jax.Array    # (n,) row permutation: (P A P^H) = A[perm][:, perm]
+    nb: int
+
+
+def _conj_t(x):
+    return jnp.conj(jnp.swapaxes(x, -1, -2))
+
+
+@lru_cache(maxsize=32)
+def _hetrf_fn(n: int, nb: int, dtype_str: str):
+    """Blocked Aasen, panels unrolled at trace time (N = n/nb static)."""
+    N = -(-n // nb)
+    np_ = N * nb
+
+    def fn(a):
+        # pad with identity: blockdiag(A, I) keeps the factorization exact
+        pad = np_ - n
+        a = jnp.pad(a, ((0, pad), (0, pad)))
+        if pad:
+            idx = jnp.arange(n, np_)
+            a = a.at[idx, idx].set(jnp.asarray(1.0, a.dtype))
+        L = jnp.eye(np_, dtype=a.dtype)
+        T = jnp.zeros((np_, np_), a.dtype)
+        perm = jnp.arange(np_)
+
+        for j in range(N):
+            j0, j1 = j * nb, (j + 1) * nb
+            # H[:, j] for block rows 0..j-1: T is Hermitian-banded so only rows
+            # 0..j0+nb of columns 0..j1 contribute; one gemm (Aasen H-column)
+            if j > 0:
+                Hcol = jnp.matmul(T[:j0, :j1 + nb],
+                                  _conj_t(L[j0:j1, :j1 + nb]),
+                                  precision=lax.Precision.HIGHEST)  # (j0, nb)
+            else:
+                Hcol = jnp.zeros((0, nb), a.dtype)
+            # A-identity: A[j][j] = sum_{k<j} L[j][k] H[k][j] + L[j][j] H[j][j]
+            LjjHjj = a[j0:j1, j0:j1] - jnp.matmul(
+                L[j0:j1, :j0], Hcol, precision=lax.Precision.HIGHEST)
+            Ljj = L[j0:j1, j0:j1]
+            Hjj = lax.linalg.triangular_solve(Ljj, LjjHjj, left_side=True,
+                                              lower=True, unit_diagonal=True)
+            # T[j][j]: H[j][j] = T[j][j-1] L[j][j-1]^H + T[j][j] L[j][j]^H
+            rhs = Hjj
+            if j > 0:
+                rhs = rhs - jnp.matmul(T[j0:j1, j0 - nb:j0],
+                                       _conj_t(L[j0:j1, j0 - nb:j0]),
+                                       precision=lax.Precision.HIGHEST)
+            # right-solve against unit upper triangular L[j][j]^H
+            Tjj = lax.linalg.triangular_solve(
+                Ljj, rhs, left_side=False, lower=True, unit_diagonal=True,
+                conjugate_a=True, transpose_a=True)
+            Tjj = (Tjj + _conj_t(Tjj)) / 2  # Hermitian up to roundoff
+            T = T.at[j0:j1, j0:j1].set(Tjj)
+
+            if j < N - 1:
+                # panel residual W = L[j+1:, j+1] T[j+1][j] L[j][j]^H
+                W = a[j1:, j0:j1]
+                if j > 0:
+                    W = W - jnp.matmul(L[j1:, :j0], Hcol,
+                                       precision=lax.Precision.HIGHEST)
+                W = W - jnp.matmul(L[j1:, j0:j1], Hjj,
+                                   precision=lax.Precision.HIGHEST)
+                plu, _, pperm = lax.linalg.lu(W)
+                L_panel = jnp.tril(plu, -1)[:, :nb] + jnp.eye(
+                    plu.shape[0], nb, dtype=a.dtype)
+                Up = jnp.triu(plu[:nb, :nb])
+                # T[j+1][j] = U_p (L[j][j]^H)^{-1}  (stays upper triangular)
+                Tj1j = lax.linalg.triangular_solve(
+                    L[j0:j1, j0:j1], Up, left_side=False, lower=True,
+                    unit_diagonal=True, conjugate_a=True, transpose_a=True)
+                T = T.at[j1:j1 + nb, j0:j1].set(Tj1j)
+                T = T.at[j0:j1, j1:j1 + nb].set(_conj_t(Tj1j))
+                # two-sided permutation of the trailing matrix + L rows + perm
+                gperm = jnp.concatenate([jnp.arange(j1), j1 + pperm])
+                a = jnp.take(jnp.take(a, gperm, axis=0), gperm, axis=1)
+                L = L.at[j1:, nb:j1].set(
+                    jnp.take(L[j1:, nb:j1], pperm, axis=0))
+                perm = jnp.take(perm, gperm)
+                L = L.at[j1:, j1:j1 + nb].set(L_panel)
+
+        return L[:n, :n], T[:n, :n], perm[:n]
+
+    return jax.jit(fn)
+
+
+def hetrf(A, opts=None, uplo=None):
+    """Aasen factorization P A P^H = L T L^H with band T (src/hetrf.cc).
+    Returns (HermitianFactors, info)."""
+    opts = Options.make(opts)
+    a = _full_herm(A, uplo)
+    n = a.shape[-1]
+    nb = min(opts.block_size, n)
+    with trace_block("hetrf", n=n, nb=nb):
+        L, T, perm = _hetrf_fn(n, nb, str(a.dtype))(a)
+        # factor the band T once here; its zero-pivot detection is the real
+        # singularity signal for the whole factorization
+        T_fac, info = gbtrf(T, opts.replace(block_size=nb), kl=nb, ku=nb)
+    return HermitianFactors(L=L, T=T, T_fac=T_fac, perm=perm, nb=nb), info
+
+
+def hetrs(fac: HermitianFactors, B, opts=None):
+    """Solve with the Aasen factorization (src/hetrs.cc): forward L sweep, band
+    solve with T (the reference's banded-T solve), backward L^H sweep, un-permute."""
+    opts = Options.make(opts)
+    b = as_array(B)
+    squeeze = b.ndim == 1
+    if squeeze:
+        b = b[:, None]
+    y = jnp.take(b, fac.perm, axis=0)
+    y = lax.linalg.triangular_solve(fac.L, y, left_side=True, lower=True,
+                                    unit_diagonal=True)
+    z = gbtrs(fac.T_fac, y, opts)
+    x = lax.linalg.triangular_solve(fac.L, z, left_side=True, lower=True,
+                                    unit_diagonal=True, conjugate_a=True,
+                                    transpose_a=True)
+    inv = jnp.argsort(fac.perm)
+    x = jnp.take(x, inv, axis=0)
+    if squeeze:
+        x = x[:, 0]
+    return write_back(B, x)
+
+
+def hesv(A, B, opts=None, uplo=None):
+    """Solve a Hermitian-indefinite system (src/hesv.cc): hetrf + hetrs.
+    Returns (X, info)."""
+    fac, info = hetrf(A, opts, uplo)
+    x = hetrs(fac, B, opts)
+    return x, info
+
+
+# real-symmetric aliases (the reference's sy* names alias he* for real scalars)
+sytrf = hetrf
+sytrs = hetrs
+sysv = hesv

@@ -1,0 +1,82 @@
+"""Symmetric/Hermitian-indefinite family: hetrf/hetrs/hesv (test_hesv.cc
+coverage: factorization identity P A P^H = L T L^H, band structure of T,
+residual of the solve on genuinely indefinite matrices)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import slate_tpu as st
+from slate_tpu.linalg import indefinite
+
+
+def random_indefinite(rng, n, complex_=False):
+    if complex_:
+        a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        a = (a + a.conj().T) / 2
+    else:
+        a = rng.standard_normal((n, n))
+        a = (a + a.T) / 2
+    # make clearly indefinite: shift half the spectrum negative
+    w, v = np.linalg.eigh(a)
+    w = w + np.where(np.arange(n) < n // 2, -n, n) * 0.1
+    return (v * w) @ v.conj().T
+
+
+@pytest.mark.parametrize("n,nb", [(64, 16), (96, 32), (100, 16), (30, 32)])
+def test_hetrf_identity(rng, n, nb):
+    a = random_indefinite(rng, n)
+    fac, info = indefinite.hetrf(jnp.asarray(a), {"block_size": nb})
+    assert int(info) == 0
+    L, T, perm = np.asarray(fac.L), np.asarray(fac.T), np.asarray(fac.perm)
+    # L unit lower triangular, first block column identity-ish
+    assert np.allclose(np.triu(L, 1), 0)
+    assert np.allclose(np.diag(L), 1)
+    nb_eff = min(nb, n)
+    assert np.allclose(L[nb_eff:, :nb_eff], 0)
+    # T is a Hermitian band of bandwidth nb
+    r = np.arange(n)[:, None]
+    c = np.arange(n)[None, :]
+    assert np.allclose(np.where(np.abs(r - c) > nb_eff, T, 0), 0)
+    assert np.allclose(T, T.conj().T, atol=1e-10)
+    # P A P^H = L T L^H
+    pa = a[perm][:, perm]
+    np.testing.assert_allclose(L @ T @ L.conj().T, pa, rtol=1e-9, atol=1e-9)
+
+
+def test_hetrf_complex(rng):
+    n, nb = 48, 16
+    a = random_indefinite(rng, n, complex_=True)
+    fac, info = indefinite.hetrf(jnp.asarray(a), {"block_size": nb})
+    assert int(info) == 0
+    L, T, perm = np.asarray(fac.L), np.asarray(fac.T), np.asarray(fac.perm)
+    pa = a[perm][:, perm]
+    np.testing.assert_allclose(L @ T @ L.conj().T, pa, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("n,nb,nrhs", [(64, 16, 3), (100, 32, 1)])
+def test_hesv(rng, n, nb, nrhs):
+    a = random_indefinite(rng, n)
+    b = rng.standard_normal((n, nrhs)) if nrhs > 1 else rng.standard_normal(n)
+    x, info = indefinite.hesv(jnp.asarray(a), jnp.asarray(b), {"block_size": nb})
+    assert int(info) == 0
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                               rtol=1e-7, atol=1e-7)
+
+
+def test_hesv_wrapper(rng):
+    n, nb = 64, 16
+    a = random_indefinite(rng, n)
+    A = st.SymmetricMatrix("lower", n, nb=nb, dtype=jnp.float64)
+    A.set_array(jnp.asarray(np.tril(a)))
+    b = rng.standard_normal((n, 2))
+    x, info = st.hesv(A, jnp.asarray(b), {"block_size": nb})
+    assert int(info) == 0
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                               rtol=1e-7, atol=1e-7)
+
+
+def test_sysv_alias():
+    assert st.sysv is st.hesv
+    assert indefinite.sytrf is indefinite.hetrf
