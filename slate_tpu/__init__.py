@@ -30,6 +30,9 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
                      pocondest, posv, posv_mixed, potrf, potri, potrs, stedc,
                      steqr, sterf, svd, svd_vals, sysv, sytrf, sytrs, tb2bd,
                      tbsm, trcondest, trtri, trtrm, unmlq, unmqr)
+from . import matgen
+from .matgen import generate_matrix
+
 try:
     # distributed layer needs jax.shard_map / NamedSharding; single-device use of
     # the library must survive without it (blas.py raises a clear SlateError if a

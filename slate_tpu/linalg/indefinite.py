@@ -49,6 +49,7 @@ class HermitianFactors(NamedTuple):
     T: jax.Array       # (n, n) dense-stored Hermitian band, bandwidth nb
     T_fac: BandLU      # band LU of T (bandwidths kl = ku = nb)
     perm: jax.Array    # (n,) row permutation: (P A P^H) = A[perm][:, perm]
+    inv_perm: jax.Array  # (n,) inverse of perm, precomputed so solves skip the argsort
     nb: int
 
 
@@ -145,7 +146,8 @@ def hetrf(A, opts=None, uplo=None):
         # factor the band T once here; its zero-pivot detection is the real
         # singularity signal for the whole factorization
         T_fac, info = gbtrf(T, opts.replace(block_size=nb), kl=nb, ku=nb)
-    return HermitianFactors(L=L, T=T, T_fac=T_fac, perm=perm, nb=nb), info
+    return HermitianFactors(L=L, T=T, T_fac=T_fac, perm=perm,
+                            inv_perm=jnp.argsort(perm), nb=nb), info
 
 
 def hetrs(fac: HermitianFactors, B, opts=None):
@@ -163,8 +165,7 @@ def hetrs(fac: HermitianFactors, B, opts=None):
     x = lax.linalg.triangular_solve(fac.L, z, left_side=True, lower=True,
                                     unit_diagonal=True, conjugate_a=True,
                                     transpose_a=True)
-    inv = jnp.argsort(fac.perm)
-    x = jnp.take(x, inv, axis=0)
+    x = jnp.take(x, fac.inv_perm, axis=0)
     if squeeze:
         x = x[:, 0]
     return write_back(B, x)

@@ -1,0 +1,450 @@
+"""Test-matrix generation library (``slate_matgen`` analogue).
+
+Reference analogue: ``matgen/`` (2659 LoC) — ``slate::generate_matrix`` with ~40 named
+matrix kinds, singular-/eigen-spectrum control via ``--cond`` and distribution
+suffixes, scaling and modifier suffixes, and a counter-based RNG so that any tile can
+be generated independently on any rank (matgen/random.cc, matgen/generate_matrix_utils.cc:70-95,
+matgen/generate_type_{rand,svd,heev}.hh, public API matgen/generate_matrix.hh:30-71).
+
+TPU re-design: entries are pure functions of the *global* index, built with jnp index
+grids (deterministic kinds) or with JAX's threefry counter-based RNG keyed per
+canonical 256x256 block (random kinds) — the same independence property as the
+reference's Philox-like generator: ``generate_tile`` produces any aligned sub-block
+without generating the rest of the matrix, so each mesh device can materialize its own
+shard. Spectrum-controlled kinds (svd/heev/poev/diag) build A = U.Sigma.V^H from the
+requested sigma distribution exactly as the reference does.
+
+Kind grammar (matching the reference's ``--matrix`` strings)::
+
+    <base>[_<dist>][_<scale>][_dominant][_zerocol<N|frac>]
+
+base: zeros ones identity ij jordan jordanT chebspec circul fiedler gfpp kms orthog
+      riemann ris zielkeNS minij hilb frank lehmer lotkin redheff triw pei tridiag
+      toeppen parter moler cauchy chow clement gcdmat
+      rand rands randn randb randr
+      diag svd poev spd heev syev
+dist (for diag/svd/poev/heev): logrand (default) arith geo cluster0 cluster1
+      rarith rgeo rcluster0 rcluster1 specified rand rands randn
+scale: ufl ofl small large
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core.exceptions import SlateError
+
+__all__ = [
+    "generate_matrix", "generate_sigma", "generate_tile", "matrix_kinds",
+    "generate_matrix_usage",
+]
+
+# canonical random-generation block: random kinds are generated per aligned
+# (_GEN_NB x _GEN_NB) block with a key folded by the block index, so any block is
+# reproducible in isolation (the reference's counter-based-RNG property)
+_GEN_NB = 256
+
+_DETERMINISTIC = (
+    "zeros ones identity ij jordan jordanT chebspec circul fiedler gfpp kms orthog "
+    "riemann ris zielkeNS minij hilb frank lehmer lotkin redheff triw pei tridiag "
+    "toeppen parter moler cauchy chow clement gcdmat"
+).split()
+_RANDOM = "rand rands randn randb randr".split()
+_SPECTRUM = "diag svd poev spd heev syev".split()
+_DISTS = ("logrand arith geo cluster0 cluster1 rarith rgeo rcluster0 rcluster1 "
+          "specified rand rands randn").split()
+_SCALES = "ufl ofl small large".split()
+
+
+def matrix_kinds() -> list:
+    """All base kind names (suffixes excluded)."""
+    return _DETERMINISTIC + _RANDOM + _SPECTRUM
+
+
+def generate_matrix_usage() -> str:
+    """Human-readable kind list (≅ generate_matrix_usage, generate_matrix_utils.cc:61-143)."""
+    return __doc__.split("Kind grammar")[1]
+
+
+def _real_dtype(dtype):
+    return jnp.zeros((), dtype).real.dtype
+
+
+def _limits(dtype):
+    info = jnp.finfo(_real_dtype(dtype))
+    ufl = float(info.tiny)
+    ofl = 1.0 / ufl
+    return ufl, ofl, float(info.eps)
+
+
+def _parse_kind(kind: str, dtype, cond: Optional[float], condD: Optional[float]):
+    """Decode base kind + dist + scaling + modifiers (≅ decode_matrix,
+    generate_matrix_utils.cc:166+)."""
+    tokens = re.split(r"[-_]", kind)
+    if not tokens or not tokens[0]:
+        raise SlateError("empty matrix kind")
+    base = tokens[0]
+    if base == "spd":
+        base = "poev"
+    if base == "syev":
+        base = "heev"
+    if base not in matrix_kinds() and base != "poev" and base != "heev":
+        raise SlateError(f"unknown matrix kind base '{tokens[0]}' in '{kind}'")
+
+    ufl, ofl, eps = _limits(dtype)
+    dist = "logrand"
+    sigma_max = 1.0
+    dominant = False
+    zero_col = None
+    for tok in tokens[1:]:
+        if tok in _DISTS:
+            dist = tok
+        elif tok == "ufl":
+            sigma_max = ufl * (1 / eps)    # representable but near underflow
+        elif tok == "ofl":
+            sigma_max = ofl * eps
+        elif tok == "small":
+            sigma_max = math.sqrt(ufl)
+        elif tok == "large":
+            sigma_max = math.sqrt(ofl)
+        elif tok == "dominant":
+            dominant = True
+        elif tok.startswith("zerocol"):
+            frac_or_n = tok[len("zerocol"):]
+            zero_col = float(frac_or_n) if "." in frac_or_n else int(frac_or_n)
+        elif tok == "":
+            continue
+        else:
+            raise SlateError(f"unknown suffix '_{tok}' in matrix kind '{kind}'")
+
+    cond = (1.0 / math.sqrt(eps)) if cond is None else float(cond)
+    condD = 1.0 if condD is None else float(condD)
+    return base, dist, cond, condD, sigma_max, dominant, zero_col
+
+
+# ---------------------------------------------------------------------------
+# deterministic kinds: entry(i, j) formulas on global 0-based index grids
+# (≅ the entry_type lambdas, generate_matrix_ge.cc:100-460)
+
+def _entries(base: str, I, J, m: int, n: int, rdtype):
+    one = jnp.ones((), rdtype)
+    mx = max(m, n)
+    if base == "zeros":
+        return jnp.zeros(I.shape, rdtype)
+    if base == "ones":
+        return jnp.ones(I.shape, rdtype)
+    if base == "identity":
+        return (I == J).astype(rdtype)
+    if base == "ij":
+        s = 1.0 / 10 ** math.ceil(math.log10(n)) if n > 1 else 0.1
+        return I.astype(rdtype) + J.astype(rdtype) * s
+    if base == "jordan":
+        return ((I == J) | (I + 1 == J)).astype(rdtype)
+    if base == "jordanT":
+        return ((I == J) | (I - 1 == J)).astype(rdtype)
+    if base == "chebspec":
+        x = lambda K: jnp.cos(jnp.pi * (K + 1) / mx).astype(rdtype)
+        xi, xj = x(I), x(J)
+        ci = jnp.where(I == mx - 1, 2.0, 1.0).astype(rdtype)
+        cj = jnp.where(J == mx - 1, 2.0, 1.0).astype(rdtype)
+        sgn = jnp.where((I + J) % 2 == 0, 1.0, -1.0).astype(rdtype)
+        off = sgn * ci / (cj * (xj - xi + jnp.where(I == J, one, 0)))
+        last = (2.0 * mx * mx + 1) / -6.0
+        diag = jnp.where(J + 1 == mx, last, -0.5 * xi / (1 - xi * xi))
+        return jnp.where(I == J, diag, off)
+    if base == "circul":
+        d = J - I
+        return (d + jnp.where(d < 0, mx, 0) + 1).astype(rdtype)
+    if base == "fiedler":
+        return jnp.abs(J - I).astype(rdtype)
+    if base == "gfpp":
+        return jnp.where(J == n - 1, one,
+                         jnp.where(I > J, -one, jnp.where(I == J, 0.5 * one, 0.0)))
+    if base == "kms":
+        return jnp.power(jnp.asarray(0.5, rdtype), jnp.abs(J - I).astype(rdtype))
+    if base == "orthog":
+        outer = math.sqrt(2.0 / (mx + 1))
+        return (outer * jnp.sin((I + 1) * (J + 1) * (jnp.pi / (mx + 1)))).astype(rdtype)
+    if base == "riemann":
+        # entry = i+1 when (i+2) divides (j+2), else -1 (gallery('riemann'): the
+        # reference's lambda transposes its own help text; we follow the documented
+        # matrix, generate_matrix_utils.cc:88)
+        return jnp.where((J + 2) % (I + 2) == 0, (I + 1).astype(rdtype), -one)
+    if base == "ris":
+        return 0.5 / (mx - J - I - 0.5).astype(rdtype)
+    if base == "zielkeNS":
+        return jnp.where(J < I, one, jnp.where((J + 1 == mx) & (I == 0), -one, 0.0))
+    if base == "minij":
+        return (jnp.minimum(I, J) + 1).astype(rdtype)
+    if base == "hilb":
+        return 1.0 / (I + J + 1).astype(rdtype)
+    if base == "frank":
+        return jnp.where(I - J > 1, 0.0,
+                         jnp.where(I - J == 1, (mx - J - 1).astype(rdtype),
+                                   (mx - J).astype(rdtype)))
+    if base == "lehmer":
+        return (jnp.minimum(I, J) + 1).astype(rdtype) / (jnp.maximum(I, J) + 1)
+    if base == "lotkin":
+        return jnp.where(I == 0, one, 1.0 / (I + J + 1).astype(rdtype))
+    if base == "redheff":
+        return (((J + 1) % (I + 1) == 0) | (J == 0)).astype(rdtype)
+    if base == "triw":
+        return jnp.where(I == J, one, jnp.where(I > J, 0.0, -one))
+    if base == "pei":
+        return jnp.where(I == J, 2 * one, one)
+    if base == "tridiag":
+        return jnp.where(I == J, 2 * one, jnp.where(jnp.abs(I - J) == 1, -one, 0.0))
+    if base == "toeppen":
+        return jnp.where(jnp.abs(J - I) == 1, (J - I).astype(rdtype) * 10,
+                         jnp.where(jnp.abs(I - J) == 2, one, 0.0))
+    if base == "parter":
+        return 1.0 / (I - J + 0.5).astype(rdtype)
+    if base == "moler":
+        return jnp.where(I == J, (I + 1).astype(rdtype),
+                         (jnp.minimum(I, J) - 1).astype(rdtype))
+    if base == "cauchy":
+        return 1.0 / (I + J + 2).astype(rdtype)
+    if base == "chow":
+        return jnp.where(I - J < -1, 0.0, 1.0).astype(rdtype)
+    if base == "clement":
+        return jnp.where(I - J == 1, (mx - J - 1).astype(rdtype),
+                         jnp.where(I - J == -1, J.astype(rdtype), 0.0))
+    if base == "gcdmat":
+        return jnp.gcd(I + 1, J + 1).astype(rdtype)
+    raise SlateError(f"unhandled deterministic kind '{base}'")
+
+
+# ---------------------------------------------------------------------------
+# random kinds: counter-based per canonical block
+
+def _rand_block(base: str, key, bi: int, bj: int, shape, dtype):
+    """One canonical block; key folded with the block's grid index, so blocks are
+    independent and reproducible (≅ random::generate taking (i_global, j_global),
+    generate_type_rand.hh:65-68)."""
+    k = jax.random.fold_in(jax.random.fold_in(key, bi), bj)
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        kr, ki = jax.random.split(k)
+        re = _rand_block(base, kr, 0, 0, shape, _real_dtype(dtype))
+        im = _rand_block(base, ki, 0, 0, shape, _real_dtype(dtype))
+        return (re + 1j * im).astype(dtype)
+    if base == "rand":
+        return jax.random.uniform(k, shape, dtype)
+    if base == "rands":
+        return jax.random.uniform(k, shape, dtype, minval=-1.0, maxval=1.0)
+    if base == "randn":
+        return jax.random.normal(k, shape, dtype)
+    if base == "randb":
+        return jax.random.bernoulli(k, 0.5, shape).astype(dtype)
+    if base == "randr":
+        return jax.random.rademacher(k, shape).astype(dtype)
+    raise SlateError(f"unhandled random kind '{base}'")
+
+
+def _rand_full(base: str, key, m: int, n: int, dtype):
+    """Assemble the full matrix from canonical blocks (vmapped fold_in keeps it one
+    XLA program)."""
+    bm = -(-m // _GEN_NB)
+    bn = -(-n // _GEN_NB)
+    # always draw whole canonical blocks (even when one covers the matrix) so the
+    # threefry counters — and hence the values — agree with generate_tile
+
+    def block(bi, bj):
+        return _rand_block(base, key, bi, bj, (_GEN_NB, _GEN_NB), dtype)
+
+    grid = jax.vmap(lambda bi: jax.vmap(lambda bj: block(bi, bj))(jnp.arange(bn)))(
+        jnp.arange(bm))                       # (bm, bn, NB, NB)
+    full = grid.transpose(0, 2, 1, 3).reshape(bm * _GEN_NB, bn * _GEN_NB)
+    return full[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# sigma distributions (≅ generate_sigma.hh)
+
+def generate_sigma(dist: str, n: int, cond: float, *, rand_sign: bool = False,
+                   sigma_max: float = 1.0, seed: int = 0,
+                   sigma: Optional[jax.Array] = None, dtype=jnp.float32) -> jax.Array:
+    """Singular/eigen value vector for the requested distribution (≅
+    matgen/generate_sigma.hh; suffix table generate_matrix_utils.cc:120-137)."""
+    rdtype = _real_dtype(dtype)
+    key = jax.random.PRNGKey(seed)
+    i = jnp.arange(n, dtype=rdtype)
+    denom = max(n - 1, 1)
+    if dist == "specified":
+        if sigma is None:
+            raise SlateError("dist 'specified' requires sigma=")
+        s = jnp.asarray(sigma, rdtype)
+    elif dist in ("logrand",):
+        lo = math.log(1.0 / cond)
+        s = jnp.exp(jax.random.uniform(key, (n,), rdtype, minval=lo, maxval=0.0))
+    elif dist in ("arith", "rarith"):
+        s = 1 - i / denom * (1 - 1 / cond)
+    elif dist in ("geo", "rgeo"):
+        s = jnp.power(jnp.asarray(cond, rdtype), -i / denom)
+    elif dist in ("cluster0", "rcluster0"):
+        s = jnp.where(i == 0, 1.0, 1.0 / cond).astype(rdtype)
+    elif dist in ("cluster1", "rcluster1"):
+        s = jnp.where(i == n - 1, 1.0 / cond, 1.0).astype(rdtype)
+    elif dist == "rand":
+        s = jax.random.uniform(key, (n,), rdtype)
+    elif dist == "rands":
+        s = jax.random.uniform(key, (n,), rdtype, minval=-1.0, maxval=1.0)
+    elif dist == "randn":
+        s = jax.random.normal(key, (n,), rdtype)
+    else:
+        raise SlateError(f"unknown sigma distribution '{dist}'")
+    if dist.startswith("r") and dist in ("rarith", "rgeo", "rcluster0", "rcluster1"):
+        s = s[::-1]
+    if rand_sign and dist not in ("rands", "randn"):
+        # heev: eigenvalues of mixed sign (poev keeps them positive)
+        signs = jax.random.rademacher(jax.random.fold_in(key, 17), (n,)).astype(rdtype)
+        s = s * signs
+    return s * sigma_max
+
+
+def _haar_q(key, rows: int, cols: int, dtype):
+    """Random orthonormal (rows x cols) factor: QR of a Gaussian block (the
+    reference forms Q the same way — geqrf of a rand matrix, generate_type_heev.hh:60-75)."""
+    g = _rand_full("randn", key, rows, cols, dtype)
+    q, r = jnp.linalg.qr(g)
+    # fix the sign convention so Q is Haar-distributed
+    d = jnp.sign(jnp.diagonal(r).real)
+    d = jnp.where(d == 0, 1.0, d).astype(dtype)
+    return q * d[None, :]
+
+
+def _cond_diag(key, n: int, condD: float, rdtype):
+    """Diagonal scaling with condition condD: log-uniform on [log(1/condD), 0]
+    (generate_type_svd.hh:159-170)."""
+    lo = math.log(1.0 / condD)
+    return jnp.exp(jax.random.uniform(key, (n,), rdtype, minval=lo, maxval=0.0))
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+def generate_matrix(kind: str, m: int, n: Optional[int] = None, *,
+                    dtype=jnp.float32, seed: int = 0, cond: Optional[float] = None,
+                    condD: Optional[float] = None,
+                    sigma: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Generate an m x n test matrix of the named kind.
+
+    Returns ``(A, Sigma)`` where Sigma is the generated singular/eigenvalue vector
+    for spectrum-controlled kinds (diag/svd/poev/heev) and None otherwise.
+    ≅ ``slate::generate_matrix`` (matgen/generate_matrix.hh:30-71).
+    """
+    n = m if n is None else n
+    base, dist, cond, condD, sigma_max, dominant, zero_col = _parse_kind(
+        kind, dtype, cond, condD)
+    rdtype = _real_dtype(dtype)
+    key = jax.random.PRNGKey(seed)
+    S = None
+
+    if base in _DETERMINISTIC:
+        I, J = jnp.meshgrid(jnp.arange(m), jnp.arange(n), indexing="ij")
+        A = _entries(base, I, J, m, n, rdtype).astype(dtype)
+        if sigma_max != 1:
+            A = A * sigma_max
+    elif base in _RANDOM:
+        A = _rand_full(base, key, m, n, dtype)
+        if sigma_max != 1:
+            A = A * sigma_max
+    elif base == "diag":
+        S = generate_sigma(dist, min(m, n), cond, sigma_max=sigma_max, seed=seed,
+                           sigma=sigma, dtype=dtype)
+        A = jnp.zeros((m, n), dtype).at[jnp.arange(min(m, n)),
+                                        jnp.arange(min(m, n))].set(S.astype(dtype))
+    elif base == "svd":
+        mn = min(m, n)
+        S = generate_sigma(dist, mn, cond, sigma_max=sigma_max, seed=seed,
+                           sigma=sigma, dtype=dtype)
+        kU, kV, kD = jax.random.split(jax.random.fold_in(key, 1), 3)
+        U = _haar_q(kU, m, mn, dtype)
+        V = _haar_q(kV, n, mn, dtype)
+        A = (U * S.astype(dtype)[None, :]) @ V.conj().T
+        if condD != 1:
+            A = A * _cond_diag(kD, n, condD, rdtype).astype(dtype)[None, :]
+    elif base in ("poev", "heev"):
+        if m != n:
+            raise SlateError(f"kind '{kind}' requires a square matrix")
+        S = generate_sigma(dist, n, cond, rand_sign=(base == "heev"),
+                           sigma_max=sigma_max, seed=seed, sigma=sigma, dtype=dtype)
+        kU, kD = jax.random.split(jax.random.fold_in(key, 1))
+        U = _haar_q(kU, n, n, dtype)
+        A = (U * S.astype(dtype)[None, :]) @ U.conj().T
+        A = (A + A.conj().T) / 2
+        if condD != 1:
+            d = _cond_diag(kD, n, condD, rdtype).astype(dtype)
+            A = A * d[None, :] * d[:, None]      # two-sided D A D
+            A = (A + A.conj().T) / 2
+    else:  # pragma: no cover
+        raise SlateError(f"unhandled kind '{kind}'")
+
+    if dominant:
+        mn = min(m, n)
+        idx = jnp.arange(mn)
+        A = A.at[idx, idx].add(jnp.asarray(n, dtype))   # generate_type_rand.hh:70-78
+    if zero_col is not None:
+        col = int(round(zero_col * (n - 1))) if isinstance(zero_col, float) else zero_col
+        if not 0 <= col < n:
+            raise SlateError(f"zerocol index {col} out of range [0, {n})")
+        A = A.at[:, col].set(0)
+        if base in ("poev", "heev") or (m == n and base in ("hilb", "minij", "pei")):
+            A = A.at[col, :].set(0)
+    return A, S
+
+
+def generate_tile(kind: str, i0: int, j0: int, mb: int, nb: int, m: int, n: int, *,
+                  dtype=jnp.float32, seed: int = 0) -> jax.Array:
+    """Generate just the (mb x nb) sub-block at global offset (i0, j0) without
+    materializing the rest — the counter-based-RNG property that lets every mesh
+    device build its own shard independently (≅ random::generate with global
+    offsets, generate_type_rand.hh:65-68).
+
+    Supported for deterministic and random kinds (spectrum-controlled kinds need
+    the global factors, use generate_matrix).
+    """
+    base, dist, cond, condD, sigma_max, dominant, zero_col = _parse_kind(
+        kind, dtype, None, None)
+    rdtype = _real_dtype(dtype)
+    if base in _DETERMINISTIC:
+        I, J = jnp.meshgrid(jnp.arange(i0, i0 + mb), jnp.arange(j0, j0 + nb),
+                            indexing="ij")
+        tile = _entries(base, I, J, m, n, rdtype).astype(dtype)
+    elif base in _RANDOM:
+        key = jax.random.PRNGKey(seed)
+        # cover with canonical aligned blocks, then slice
+        b0, b1 = i0 // _GEN_NB, (i0 + mb - 1) // _GEN_NB
+        c0, c1 = j0 // _GEN_NB, (j0 + nb - 1) // _GEN_NB
+        rows = []
+        for bi in range(b0, b1 + 1):
+            row = [_rand_block(base, key, bi, bj, (_GEN_NB, _GEN_NB), dtype)
+                   for bj in range(c0, c1 + 1)]
+            rows.append(jnp.concatenate(row, axis=1))
+        cover = jnp.concatenate(rows, axis=0)
+        tile = cover[i0 - b0 * _GEN_NB: i0 - b0 * _GEN_NB + mb,
+                     j0 - c0 * _GEN_NB: j0 - c0 * _GEN_NB + nb]
+    else:
+        raise SlateError(
+            f"generate_tile supports deterministic/random kinds, not '{kind}'")
+    if sigma_max != 1:
+        tile = tile * sigma_max
+    if dominant or zero_col is not None:
+        I, J = jnp.meshgrid(jnp.arange(i0, i0 + mb), jnp.arange(j0, j0 + nb),
+                            indexing="ij")
+        if dominant:
+            tile = jnp.where((I == J) & (I < min(m, n)), tile + n, tile)
+        if zero_col is not None:
+            col = (int(round(zero_col * (n - 1))) if isinstance(zero_col, float)
+                   else zero_col)
+            tile = jnp.where(J == col, 0, tile)
+            if m == n and base in ("hilb", "minij", "pei"):  # symmetric kinds zero the row too
+                tile = jnp.where(I == col, 0, tile)
+    return tile

@@ -1,0 +1,236 @@
+"""matgen tests (≅ the reference's generator checks inside test/matgen.hh usage)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu import matgen
+from slate_tpu.core.exceptions import SlateError
+
+
+def npa(x):
+    return np.asarray(x)
+
+
+class TestDeterministicKinds:
+    def test_identity(self):
+        A, S = matgen.generate_matrix("identity", 5, 7)
+        assert S is None
+        np.testing.assert_allclose(npa(A), np.eye(5, 7, dtype=np.float32))
+
+    def test_zeros_ones(self):
+        A, _ = matgen.generate_matrix("zeros", 4)
+        assert not npa(A).any()
+        A, _ = matgen.generate_matrix("ones", 4)
+        assert (npa(A) == 1).all()
+
+    def test_hilb(self):
+        A, _ = matgen.generate_matrix("hilb", 4, dtype=jnp.float64
+                                      if jax.config.jax_enable_x64 else jnp.float32)
+        expect = 1.0 / (np.arange(4)[:, None] + np.arange(4)[None, :] + 1)
+        np.testing.assert_allclose(npa(A), expect, rtol=1e-6)
+
+    def test_minij_moler_lehmer(self):
+        n = 6
+        I, J = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+        A, _ = matgen.generate_matrix("minij", n)
+        np.testing.assert_allclose(npa(A), np.minimum(I, J) + 1)
+        A, _ = matgen.generate_matrix("lehmer", n)
+        np.testing.assert_allclose(npa(A),
+                                   (np.minimum(I, J) + 1) / (np.maximum(I, J) + 1),
+                                   rtol=1e-6)
+        A, _ = matgen.generate_matrix("moler", n)
+        np.testing.assert_allclose(npa(A),
+                                   np.where(I == J, I + 1, np.minimum(I, J) - 1))
+
+    def test_jordan_tridiag_circulant(self):
+        n = 5
+        A, _ = matgen.generate_matrix("jordan", n)
+        assert (np.diag(npa(A)) == 1).all() and (np.diag(npa(A), 1) == 1).all()
+        A, _ = matgen.generate_matrix("tridiag", n)
+        assert (np.diag(npa(A)) == 2).all() and (np.diag(npa(A), -1) == -1).all()
+        A, _ = matgen.generate_matrix("circul", n)
+        np.testing.assert_allclose(npa(A)[:, 0], [1, 5, 4, 3, 2])
+
+    def test_orthog_is_orthogonal(self):
+        A, _ = matgen.generate_matrix("orthog", 32)
+        G = npa(A).T @ npa(A)
+        np.testing.assert_allclose(G, np.eye(32), atol=1e-4)
+
+    def test_gcdmat(self):
+        A, _ = matgen.generate_matrix("gcdmat", 6)
+        assert npa(A)[3, 5] == math.gcd(4, 6)
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(SlateError):
+            matgen.generate_matrix("nosuchkind", 4)
+        with pytest.raises(SlateError):
+            matgen.generate_matrix("rand_nosuffix", 4)
+
+
+class TestRandomKinds:
+    def test_ranges(self):
+        for kind, lo, hi in [("rand", 0, 1), ("rands", -1, 1)]:
+            A, _ = matgen.generate_matrix(kind, 64, 48, seed=3)
+            a = npa(A)
+            assert a.min() >= lo and a.max() <= hi and a.std() > 0.1
+
+    def test_randb_randr(self):
+        A, _ = matgen.generate_matrix("randb", 64)
+        assert set(np.unique(npa(A))) <= {0.0, 1.0}
+        A, _ = matgen.generate_matrix("randr", 64)
+        assert set(np.unique(npa(A))) <= {-1.0, 1.0}
+
+    def test_deterministic_in_seed(self):
+        A1, _ = matgen.generate_matrix("randn", 40, seed=7)
+        A2, _ = matgen.generate_matrix("randn", 40, seed=7)
+        A3, _ = matgen.generate_matrix("randn", 40, seed=8)
+        np.testing.assert_array_equal(npa(A1), npa(A2))
+        assert not np.array_equal(npa(A1), npa(A3))
+
+    def test_tile_independence(self):
+        """generate_tile of a sub-block equals the same region of the full matrix —
+        the counter-based-RNG property."""
+        m = n = 600   # spans multiple canonical 256-blocks
+        A, _ = matgen.generate_matrix("randn", m, n, seed=5)
+        for (i0, j0, mb, nb) in [(0, 0, 64, 64), (256, 256, 100, 100),
+                                 (300, 500, 200, 100), (512, 0, 88, 300)]:
+            tile = matgen.generate_tile("randn", i0, j0, mb, nb, m, n, seed=5)
+            np.testing.assert_array_equal(npa(A)[i0:i0 + mb, j0:j0 + nb], npa(tile))
+
+    def test_tile_independence_small(self):
+        """Consistency must also hold when the whole matrix fits one canonical
+        256-block (regression: _rand_full used a different counter layout there)."""
+        A, _ = matgen.generate_matrix("randn", 100, 100, seed=5)
+        tile = matgen.generate_tile("randn", 0, 0, 50, 50, 100, 100, seed=5)
+        np.testing.assert_array_equal(npa(A)[:50, :50], npa(tile))
+
+    def test_tile_zerocol(self):
+        A, _ = matgen.generate_matrix("randn_zerocol3", 16, seed=1)
+        tile = matgen.generate_tile("randn_zerocol3", 0, 0, 16, 16, 16, 16, seed=1)
+        np.testing.assert_array_equal(npa(A), npa(tile))
+
+    def test_riemann(self):
+        # gallery('riemann'): entry(i,j) = i+1 if (i+2) divides (j+2) else -1
+        A, _ = matgen.generate_matrix("riemann", 6)
+        np.testing.assert_allclose(npa(A)[0], [1, -1, 1, -1, 1, -1])
+        np.testing.assert_allclose(npa(A)[2], [-1, -1, 3, -1, -1, -1])
+
+    def test_tile_deterministic_kind(self):
+        A, _ = matgen.generate_matrix("hilb", 300, 300)
+        tile = matgen.generate_tile("hilb", 100, 37, 50, 60, 300, 300)
+        np.testing.assert_allclose(npa(A)[100:150, 37:97], npa(tile), rtol=1e-6)
+
+    def test_dominant(self):
+        A, _ = matgen.generate_matrix("rands_dominant", 32, seed=1)
+        a = npa(A)
+        off = np.abs(a) - np.diag(np.abs(np.diag(a)))
+        assert (np.abs(np.diag(a)) > off.sum(axis=1)).all()
+
+    def test_zerocol(self):
+        A, _ = matgen.generate_matrix("randn_zerocol3", 16, seed=1)
+        assert not npa(A)[:, 3].any()
+        A, _ = matgen.generate_matrix("randn_zerocol0.5", 16, seed=1)
+        assert not npa(A)[:, round(0.5 * 15)].any()
+
+
+class TestSpectrumKinds:
+    def test_diag(self):
+        A, S = matgen.generate_matrix("diag_geo", 8, cond=100.0)
+        np.testing.assert_allclose(np.diag(npa(A)), npa(S), rtol=1e-6)
+        r = npa(S)
+        np.testing.assert_allclose(r[0] / r[-1], 100.0, rtol=1e-4)
+
+    def test_svd_cond_control(self):
+        n, cond = 48, 1000.0
+        A, S = matgen.generate_matrix("svd_geo", n, cond=cond, seed=2)
+        sv = np.linalg.svd(npa(A), compute_uv=False)
+        np.testing.assert_allclose(sv, np.sort(npa(S))[::-1], rtol=1e-3)
+        np.testing.assert_allclose(sv[0] / sv[-1], cond, rtol=1e-2)
+
+    def test_svd_rectangular(self):
+        A, S = matgen.generate_matrix("svd_arith", 40, 24, cond=50.0, seed=3)
+        assert A.shape == (40, 24) and S.shape == (24,)
+        sv = np.linalg.svd(npa(A), compute_uv=False)
+        np.testing.assert_allclose(sv, np.sort(npa(S))[::-1], rtol=1e-3)
+
+    def test_poev_spd(self):
+        n = 32
+        A, S = matgen.generate_matrix("poev_cluster1", n, cond=10.0, seed=4)
+        a = npa(A)
+        np.testing.assert_allclose(a, a.T, atol=1e-5)
+        w = np.linalg.eigvalsh(a)
+        assert w.min() > 0
+        np.testing.assert_allclose(np.sort(w), np.sort(npa(S)), rtol=1e-3, atol=1e-5)
+
+    def test_spd_alias(self):
+        A1, _ = matgen.generate_matrix("spd_geo", 16, cond=10.0, seed=5)
+        A2, _ = matgen.generate_matrix("poev_geo", 16, cond=10.0, seed=5)
+        np.testing.assert_array_equal(npa(A1), npa(A2))
+
+    def test_heev_mixed_signs(self):
+        A, S = matgen.generate_matrix("heev_logrand", 48, cond=100.0, seed=6)
+        s = npa(S)
+        assert (s > 0).any() and (s < 0).any()
+        w = np.linalg.eigvalsh(npa(A))
+        np.testing.assert_allclose(np.sort(w), np.sort(s), rtol=1e-3, atol=1e-5)
+
+    def test_sigma_specified(self):
+        sig = jnp.asarray([4.0, 3.0, 2.0, 1.0])
+        A, S = matgen.generate_matrix("svd_specified", 4, sigma=sig, seed=1)
+        sv = np.linalg.svd(npa(A), compute_uv=False)
+        np.testing.assert_allclose(sv, [4, 3, 2, 1], rtol=1e-4)
+
+    def test_condD_scaling(self):
+        A, _ = matgen.generate_matrix("svd_geo", 32, cond=10.0, condD=100.0, seed=7)
+        # column scaling spreads column norms by ~condD
+        norms = np.linalg.norm(npa(A), axis=0)
+        assert norms.max() / norms.min() > 5.0
+
+    def test_heev_requires_square(self):
+        with pytest.raises(SlateError):
+            matgen.generate_matrix("heev", 8, 12)
+
+    def test_sigma_distributions(self):
+        n, cond = 16, 64.0
+        arith = npa(matgen.generate_sigma("arith", n, cond))
+        np.testing.assert_allclose(np.diff(arith), np.diff(arith)[0] * np.ones(n - 1),
+                                   rtol=1e-4)
+        geo = npa(matgen.generate_sigma("geo", n, cond))
+        ratios = geo[1:] / geo[:-1]
+        np.testing.assert_allclose(ratios, ratios[0] * np.ones(n - 1), rtol=1e-3)
+        c0 = npa(matgen.generate_sigma("cluster0", n, cond))
+        assert c0[0] == 1 and np.allclose(c0[1:], 1 / cond)
+        rc0 = npa(matgen.generate_sigma("rcluster0", n, cond))
+        np.testing.assert_allclose(rc0, c0[::-1])
+        lr = npa(matgen.generate_sigma("logrand", n, cond, seed=3))
+        assert (lr >= 1 / cond - 1e-6).all() and (lr <= 1.0 + 1e-6).all()
+
+
+class TestScaling:
+    def test_small_large(self):
+        A, _ = matgen.generate_matrix("rand_small", 16, seed=1)
+        assert 0 < np.abs(npa(A)).max() < 1e-15
+        A, _ = matgen.generate_matrix("rand_large", 16, seed=1)
+        assert np.abs(npa(A)).max() > 1e15
+
+    def test_kinds_all_generate(self):
+        """Every advertised kind produces a finite matrix (smoke, ≅ tester sweep)."""
+        for kind in matgen.matrix_kinds():
+            A, _ = matgen.generate_matrix(kind, 12, 12, seed=1)
+            assert A.shape == (12, 12)
+            assert bool(jnp.isfinite(A).all()), kind
+
+    def test_complex_dtype(self):
+        A, _ = matgen.generate_matrix("randn", 24, dtype=jnp.complex64, seed=2)
+        assert A.dtype == jnp.complex64
+        assert np.abs(npa(A).imag).max() > 0
+        A, S = matgen.generate_matrix("heev_geo", 24, dtype=jnp.complex64, seed=2)
+        a = npa(A)
+        np.testing.assert_allclose(a, a.conj().T, atol=1e-5)
+        w = np.linalg.eigvalsh(a)
+        np.testing.assert_allclose(np.sort(w), np.sort(npa(S)), rtol=1e-3, atol=1e-4)
