@@ -23,13 +23,16 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
 from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
                    scale_row_col, set, symm, syr2k, syrk, trmm, trsm)
 from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
-                     gelqf, gels, geqrf, gerbt, gesv, gesv_mixed,
-                     gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf, getrf_nopiv,
-                     getrf_tntpiv, getri, getrs, hb2st, hbmm, he2hb, heev, hegst,
+                     gelqf, gels, gels_cholqr, gels_qr, geqrf, gerbt, gesv,
+                     gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf,
+                     getrf_nopiv, getrf_tntpiv, getri, getri_oop, getrs,
+                     getrs_nopiv, hb2st, hbmm, he2hb, he2hb_q, heev, hegst,
                      hegv, hesv, hetrf, hetrs, norm1est, pbsv, pbtrf, pbtrs,
-                     pocondest, posv, posv_mixed, potrf, potri, potrs, stedc,
-                     steqr, sterf, svd, svd_vals, sysv, sytrf, sytrs, tb2bd,
-                     tbsm, trcondest, trtri, trtrm, unmlq, unmqr)
+                     pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri,
+                     potrs, stedc, steqr, sterf, svd, svd_vals, sysv, sytrf,
+                     sytrs, tb2bd, tbsm, trcondest, trtri, trtrm, unmbr_ge2tb,
+                     unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)
+from . import simplified
 from . import matgen
 from .matgen import generate_matrix
 

@@ -1,12 +1,15 @@
 """Driver algorithms (reference L4, src/*.cc)."""
 
-from .chol import posv, posv_mixed, potrf, potri, potrs, trtri, trtrm
+from .chol import (posv, posv_mixed, posv_mixed_gmres, potrf, potri, potrs, trtri,
+                   trtrm)
 from .lu import (gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
-                 getrf, getrf_nopiv, getrf_tntpiv, getri, getrs, perm_to_pivots,
-                 rbt_generate)
-from .qr import (TriangularFactors, cholqr, gelqf, gels, geqrf, tsqr, unmlq, unmqr)
-from .eig import (hb2st, he2hb, heev, hegst, hegv, stedc, steqr, sterf)
-from .svd import bdsqr, ge2tb, svd, svd_vals, tb2bd
+                 getrf, getrf_nopiv, getrf_tntpiv, getri, getri_oop, getrs,
+                 getrs_nopiv, perm_to_pivots, rbt_generate)
+from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
+                 geqrf, tsqr, unmlq, unmqr)
+from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr, sterf,
+                  unmtr_hb2st, unmtr_he2hb)
+from .svd import (bdsqr, ge2tb, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest
 from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
                    tbsm)

@@ -345,3 +345,43 @@ def posv_mixed(A, B, opts=None, uplo=None):
         X, info = posv(A, B, opts, uplo)   # full-precision fallback
         return X, info, iters
     return write_back(B, x), info, iters
+
+
+def posv_mixed_gmres(A, B, opts=None, uplo=None):
+    """SPD GMRES-IR: FGMRES in working precision, right-preconditioned by the
+    low-precision Cholesky solve (src/posv_mixed_gmres.cc; single RHS like the
+    reference). Returns (X, info, iters)."""
+    from .lu import _gmres_ir
+
+    opts = Options.make(opts)
+    the_uplo = uplo or (A.uplo if isinstance(A, BaseMatrix) and A.uplo != Uplo.General
+                        else Uplo.Lower)
+    Af = _full_spd(A, None if isinstance(A, (HermitianMatrix, SymmetricMatrix))
+                   else the_uplo)
+    b = as_array(B)
+    lo = opts.factor_precision or _lower_precision(Af.dtype)
+    if lo is None:
+        X, info = posv(A, B, opts, uplo)
+        return X, info, jnp.int32(0)
+
+    with trace_block("posv_mixed_gmres", lo=str(lo)):
+        L_lo = lax.linalg.cholesky(Af.astype(lo))
+        info = _chol_info(L_lo)
+
+        def precond(r):
+            y = lax.linalg.triangular_solve(L_lo, r.astype(lo)[:, None],
+                                            left_side=True, lower=True)
+            z = lax.linalg.triangular_solve(L_lo, y, left_side=True, lower=True,
+                                            conjugate_a=True, transpose_a=True)
+            return z[:, 0].astype(b.dtype)
+
+        def matvec(x):
+            return jnp.matmul(Af, x, precision=lax.Precision.HIGHEST)
+
+        x_out, restarts, converged = _gmres_ir(matvec, precond, b, opts,
+                                               "posv_mixed_gmres")
+
+    if opts.use_fallback_solver and not converged:
+        X, info = posv(A, B, opts, uplo)
+        return X, info, jnp.int32(-1)
+    return write_back(B, x_out), info, jnp.int32(restarts)

@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.exceptions import SlateError
-from ..core.matrix import BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array
+from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array,
+                           write_back)
 from ..core.types import MethodEig, Norm, Options, Target, Uplo
 from ..ops import norms as norm_ops
 from ..utils.trace import Timers, trace_block
@@ -152,7 +153,51 @@ def he2hb(A, opts=None, uplo=None):
     return band, arr, taus
 
 
-def hb2st(band, opts=None):
+def _apply_q(side, op, Q, C):
+    """C <- op(Q) C (Side.Left) or C op(Q) (Side.Right) — the shared body of the
+    unm* back-transform appliers."""
+    from ..core.types import Op, Side
+
+    side = Side.from_string(side) if not isinstance(side, Side) else side
+    op = Op.from_string(op) if not isinstance(op, Op) else op
+    q = as_array(Q)
+    if op == Op.Trans:
+        q = jnp.swapaxes(q, -1, -2)
+    elif op == Op.ConjTrans:
+        q = jnp.conj(jnp.swapaxes(q, -1, -2))
+    c = as_array(C)
+    out = (jnp.matmul(q, c, precision=lax.Precision.HIGHEST) if side == Side.Left
+           else jnp.matmul(c, q, precision=lax.Precision.HIGHEST))
+    return write_back(C, out)
+
+
+def he2hb_q(reflectors, taus) -> jax.Array:
+    """Materialize the stage-1 Q from he2hb's packed reflectors: Q = diag(1, Q')
+    with Q' accumulated from the sub-diagonal Householder vectors (LAPACK unghtr
+    convention — the packing lax.linalg.tridiagonal produces)."""
+    arr = as_array(reflectors)
+    n = arr.shape[-1]
+    Qs = lax.linalg.householder_product(arr[..., 1:, : n - 1], taus)
+    Q = jnp.zeros_like(arr)
+    Q = Q.at[..., 0, 0].set(1.0)
+    return Q.at[..., 1:, 1:].set(Qs)
+
+
+def unmtr_he2hb(side, op, reflectors, taus, C, opts=None):
+    """Apply the stage-1 (full -> band) orthogonal factor to C
+    (src/unmtr_he2hb.cc).  ``reflectors, taus`` are he2hb's packed outputs."""
+    return _apply_q(side, op, he2hb_q(reflectors, taus), C)
+
+
+def unmtr_hb2st(side, op, V, C, opts=None):
+    """Apply the stage-2 (band -> tridiagonal) factor to C (src/unmtr_hb2st.cc).
+    ``V`` is the dense Q2 returned by ``hb2st(..., want_vectors=True)`` — the
+    reference stores bulge-chasing reflectors instead; here stage 2 runs as one
+    fused XLA op so Q2 is already materialized."""
+    return _apply_q(side, op, V, C)
+
+
+def hb2st(band, opts=None, want_vectors: bool = False):
     """Stage 2: band -> real symmetric tridiagonal (src/hb2st.cc bulge chasing).
     With he2hb already producing tridiagonal form, this extracts (d, e); a wider
     band is reduced through the dense Householder tridiagonalization (one fused XLA
@@ -170,8 +215,12 @@ def hb2st(band, opts=None):
             full = jnp.tril(b) + jnp.conj(jnp.swapaxes(jnp.tril(b, -1), -1, -2))
         else:
             full = jnp.triu(b) + jnp.conj(jnp.swapaxes(jnp.triu(b, 1), -1, -2))
-        _, d, e, _ = lax.linalg.tridiagonal(full, lower=True)
-        return jnp.real(d), jnp.abs(e)
+        arr, d, e_c, taus = lax.linalg.tridiagonal(full, lower=True)
+        if not want_vectors:
+            return jnp.real(d), jnp.abs(e_c)
+        Q2 = he2hb_q(arr, taus)
+        Q2 = Q2 * _phase_vector(e_c.astype(b.dtype))[..., None, :]
+        return jnp.real(d), jnp.abs(e_c), Q2
     d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))
     e_c = b[..., idx[1:], idx[:-1]]
     # an upper-stored tridiagonal band keeps its offdiagonal in the superdiagonal
@@ -180,7 +229,20 @@ def hb2st(band, opts=None):
     # rotate away complex phases on the subdiagonal (the unitary diagonal similarity
     # the reference's bulge-chasing accumulates into V)
     e = jnp.abs(e_c)
-    return d, e
+    if not want_vectors:
+        return d, e
+    Q2 = jnp.zeros(b.shape, b.dtype).at[..., idx, idx].set(_phase_vector(e_c))
+    return d, e, Q2
+
+
+def _phase_vector(e_c: jax.Array) -> jax.Array:
+    """Cumulative phases p (p[0]=1, p[k+1] = p[k]·e_k/|e_k|) such that with
+    D = diag(p) the complex tridiagonal T_c = D T_real D^H — the unitary diagonal
+    similarity that makes the off-diagonal real nonnegative."""
+    mag = jnp.abs(e_c)
+    ph = jnp.where(mag > 0, e_c / jnp.where(mag > 0, mag, 1), 1).astype(e_c.dtype)
+    return jnp.concatenate([jnp.ones_like(ph[..., :1]),
+                            jnp.cumprod(ph, axis=-1)], axis=-1)
 
 
 def _assemble_tridiag(d, e) -> jax.Array:

@@ -338,6 +338,12 @@ def getrs(LU, perm, B, opts=None, trans=False):
     return write_back(B, lu_factored_solve(lu_, perm, b))
 
 
+def getrs_nopiv(LU, B, opts=None, trans=False):
+    """Solve from a pivot-free LU factor (src/getrs_nopiv.cc): the two triangular
+    sweeps with no row permutation."""
+    return getrs(LU, None, B, opts, trans=trans)
+
+
 def gesv(A, B, opts=None):
     """Solve A X = B (src/gesv.cc = getrf + getrs). Returns (X, perm, info)."""
     lu_, perm, info = getrf(A, opts)
@@ -358,6 +364,16 @@ def getri(A, opts=None):
     lu_, perm, info = getrf(A, opts)
     X = getrs(lu_, perm, jnp.eye(n, dtype=a.dtype), opts)
     return write_back(A, X), info
+
+
+def getri_oop(A, B, opts=None):
+    """Out-of-place inverse (src/getriOOP.cc): writes A^{-1} into B, leaving A
+    untouched — the reference offers this so the LU factor survives for reuse."""
+    a = as_array(A)
+    n = a.shape[-1]
+    lu_, perm, info = getrf(jnp.array(a), opts)   # factor a copy, not A itself
+    X = getrs(lu_, perm, jnp.eye(n, dtype=a.dtype), opts)
+    return write_back(B, X), info
 
 
 # ---------------------------------------------------------------------------
@@ -427,6 +443,25 @@ def _fgmres(matvec, precond, b, x0, restart, tol, max_restarts):
     return x, restarts
 
 
+def _gmres_ir(matvec, precond, b, opts, routine: str):
+    """Shared GMRES-IR body for gesv_mixed_gmres / posv_mixed_gmres: single-RHS
+    validation, tolerance, restarted FGMRES, NaN-safe convergence verdict.
+    Returns (x shaped like b, restarts, converged)."""
+    squeeze = b.ndim == 1
+    if not squeeze and b.shape[-1] != 1:
+        raise SlateError(f"{routine} supports a single RHS (matches reference)")
+    bv = b.reshape(-1) if not squeeze else b
+    n = bv.shape[0]
+    eps = jnp.finfo(bv.dtype).eps
+    tol = (opts.tolerance if opts.tolerance is not None
+           else float(eps) * (n ** 0.5)) * float(jnp.linalg.norm(bv))
+    x, restarts = _fgmres(matvec, precond, bv, precond(bv), restart=min(30, n),
+                          tol=tol, max_restarts=opts.max_iterations // 10 + 1)
+    resid = float(jnp.linalg.norm(bv - matvec(x)))
+    converged = resid <= tol * 10        # NaN residual fails this, forcing fallback
+    return (x if squeeze else x[:, None]), restarts, converged
+
+
 def gesv_mixed_gmres(A, B, opts=None):
     """GMRES-IR: FGMRES in working precision, right-preconditioned by the
     low-precision LU solve (src/gesv_mixed_gmres.cc). Single-RHS path like the
@@ -436,10 +471,6 @@ def gesv_mixed_gmres(A, B, opts=None):
     opts = Options.make(opts)
     a = as_array(A)
     b = as_array(B)
-    squeeze = b.ndim == 1
-    if not squeeze and b.shape[-1] != 1:
-        raise SlateError("gesv_mixed_gmres supports a single RHS (matches reference)")
-    bv = b.reshape(-1) if not squeeze else b
     lo = opts.factor_precision or _lower_precision(a.dtype)
     if lo is None:
         X, perm, info = gesv(A, B, opts)
@@ -456,16 +487,10 @@ def gesv_mixed_gmres(A, B, opts=None):
         def matvec(x):
             return jnp.matmul(a, x, precision=lax.Precision.HIGHEST)
 
-        n = a.shape[-1]
-        eps = jnp.finfo(bv.dtype).eps
-        tol = (opts.tolerance if opts.tolerance is not None
-               else float(eps) * (n ** 0.5)) * float(jnp.linalg.norm(bv))
-        x, restarts = _fgmres(matvec, precond, bv, precond(bv), restart=min(30, n),
-                              tol=tol, max_restarts=opts.max_iterations // 10 + 1)
+        x_out, restarts, converged = _gmres_ir(matvec, precond, b, opts,
+                                               "gesv_mixed_gmres")
 
-    x_out = x if squeeze else x[:, None]
-    resid = float(jnp.linalg.norm(bv - matvec(x)))
-    if opts.use_fallback_solver and resid > tol * 10:
+    if opts.use_fallback_solver and not converged:
         X, perm, info = gesv(A, B, opts)
         return X, perm, info, jnp.int32(-1)
     return write_back(B, x_out), perm, info, jnp.int32(restarts)

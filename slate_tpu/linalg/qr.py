@@ -292,3 +292,13 @@ def gels(A, BX, opts=None):
             x = unmqr("left", "n", fac, ypad)  # Q1 ypad = Q^H ypad
     return write_back(BX, x) if (isinstance(BX, BaseMatrix)
                                  and as_array(BX).shape == x.shape) else x
+
+
+def gels_qr(A, BX, opts=None):
+    """Least squares via Householder QR explicitly (src/gels_qr.cc)."""
+    return gels(A, BX, Options.make(opts).replace(method_gels=MethodGels.QR))
+
+
+def gels_cholqr(A, BX, opts=None):
+    """Least squares via CholeskyQR explicitly (src/gels_cholqr.cc)."""
+    return gels(A, BX, Options.make(opts).replace(method_gels=MethodGels.CholQR))

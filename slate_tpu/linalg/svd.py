@@ -154,19 +154,41 @@ def ge2tb(A, opts=None):
     return d, e, jnp.asarray(Uh[:, :k]), jnp.asarray(Vh.conj().T[:k, :])
 
 
-def tb2bd(band, kd, opts=None):
+def tb2bd(band, kd, opts=None, want_vectors: bool = False):
     """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc).  For the kd=1
     output of ge2tb this is the identity extraction of (d, e); a wider band (kd > 1)
     is re-bidiagonalized through the ge2tb Householder pass — correct for any kd,
-    with the O(n*kd) bulge chase tracked for a later round."""
+    with the O(n*kd) bulge chase tracked for a later round.
+
+    With want_vectors, returns (d, e, U2, VT2) such that band = U2 B VT2."""
     b = as_array(band)
     if kd > 1:
-        d, e, _, _ = ge2tb(b, opts)
-        return d, e
+        d, e, U2, VT2 = ge2tb(b, opts)
+        return (d, e, U2, VT2) if want_vectors else (d, e)
     k = min(b.shape[-2:])
     d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))[:k]
     e = jnp.real(jnp.diagonal(b, offset=1, axis1=-2, axis2=-1))[: k - 1]
-    return d, e
+    if not want_vectors:
+        return d, e
+    m, n = b.shape[-2:]
+    U2 = jnp.eye(m, k, dtype=b.dtype)
+    VT2 = jnp.eye(k, n, dtype=b.dtype)
+    return d, e, U2, VT2
+
+
+def unmbr_ge2tb(side, op, Q, C, opts=None):
+    """Apply the stage-1 bidiagonalization factor (U or V^H from ge2tb) to C
+    (src/unmbr_ge2tb.cc).  Here ge2tb returns U/VT materialized, so application is
+    one MXU matmul."""
+    from .eig import _apply_q
+    return _apply_q(side, op, Q, C)
+
+
+def unmbr_tb2bd(side, op, Q, C, opts=None):
+    """Apply the stage-2 (band -> bidiagonal) factor from
+    ``tb2bd(..., want_vectors=True)`` to C (src/unmbr_tb2bd.cc)."""
+    from .eig import _apply_q
+    return _apply_q(side, op, Q, C)
 
 
 def bdsqr(d, e, opts=None, want_vectors: bool = False):

@@ -34,8 +34,6 @@ import math
 import re
 from typing import Optional, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 

@@ -1,0 +1,209 @@
+"""Simplified verb API + newly-added routine variants (simplified_api.hh parity,
+src/{getrs_nopiv,getriOOP,posv_mixed_gmres,gels_qr,gels_cholqr,unmtr_*,unmbr_*}.cc)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import slate_tpu as slate
+from slate_tpu import simplified as s
+from slate_tpu import matgen
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def spd(n, seed=0, dtype=np.float32):
+    a = rng(seed).standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+class TestVerbAliases:
+    def test_multiply_is_gemm(self):
+        a = rng(1).standard_normal((8, 6)).astype(np.float32)
+        b = rng(2).standard_normal((6, 5)).astype(np.float32)
+        C = slate.Matrix.from_array(np.zeros((8, 5), np.float32), nb=4)
+        s.multiply(1.0, slate.Matrix.from_array(a, nb=4),
+                   slate.Matrix.from_array(b, nb=4), 0.0, C)
+        np.testing.assert_allclose(np.asarray(C.array), a @ b, rtol=1e-5)
+
+    def test_chol_verbs_round_trip(self):
+        n = 24
+        a = spd(n, 3)
+        b = rng(4).standard_normal((n, 2)).astype(np.float32)
+        M = slate.HermitianMatrix.from_array(slate.Uplo.Lower, a.copy(), nb=8)
+        B = slate.Matrix.from_array(b.copy(), nb=8)
+        info = s.chol_solve(M, B)
+        np.testing.assert_allclose(a @ np.asarray(B.array), b, rtol=1e-2, atol=1e-3)
+
+    def test_lu_verbs(self):
+        n = 16
+        a = rng(5).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+        b = rng(6).standard_normal((n,)).astype(np.float32)
+        lu_, perm, info = s.lu_factor(slate.Matrix.from_array(a.copy(), nb=8))
+        x = s.lu_solve_using_factor(lu_, perm, b.copy())
+        np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-3, atol=1e-4)
+
+    def test_eig_vals_verb(self):
+        a = spd(20, 7)
+        lam = s.eig_vals(slate.HermitianMatrix.from_array(slate.Uplo.Lower, a, nb=8))
+        np.testing.assert_allclose(np.sort(np.asarray(lam)),
+                                   np.linalg.eigvalsh(a), rtol=1e-3)
+
+    def test_least_squares_verb(self):
+        a = rng(8).standard_normal((32, 8)).astype(np.float32)
+        b = rng(9).standard_normal((32, 2)).astype(np.float32)
+        x = s.least_squares_solve(a, b)
+        expect, *_ = np.linalg.lstsq(a, b, rcond=None)
+        np.testing.assert_allclose(np.asarray(x), expect, rtol=1e-3, atol=1e-4)
+
+
+class TestNewVariants:
+    def test_getrs_nopiv(self):
+        n = 12
+        a = spd(n, 1)   # SPD needs no pivoting
+        b = rng(2).standard_normal((n, 3)).astype(np.float32)
+        lu_, info = slate.getrf_nopiv(a.copy())
+        x = slate.getrs_nopiv(lu_, b.copy())
+        np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-3)
+
+    def test_getri_oop_preserves_A(self):
+        n = 10
+        a = rng(3).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+        A = slate.Matrix.from_array(a.copy(), nb=4)
+        Out = slate.Matrix.from_array(np.zeros_like(a), nb=4)
+        inv, info = slate.getri_oop(A, Out)
+        np.testing.assert_array_equal(np.asarray(A.array), a)  # untouched
+        np.testing.assert_allclose(a @ np.asarray(Out.array), np.eye(n),
+                                   atol=1e-3)
+
+    def test_posv_mixed_gmres(self):
+        n = 32
+        a = spd(n, 4, np.float64)
+        b = rng(5).standard_normal((n,))
+        X, info, iters = slate.posv_mixed_gmres(a, b.copy())
+        np.testing.assert_allclose(a @ np.asarray(X), b, rtol=1e-8)
+        assert int(info) == 0
+
+    def test_gels_qr_vs_cholqr(self):
+        a = rng(6).standard_normal((64, 8)).astype(np.float32)
+        b = rng(7).standard_normal((64, 1)).astype(np.float32)
+        x1 = slate.gels_qr(a, b.copy())
+        x2 = slate.gels_cholqr(a, b.copy())
+        np.testing.assert_allclose(np.asarray(x1), np.asarray(x2), rtol=1e-2,
+                                   atol=1e-3)
+
+
+class TestBackTransforms:
+    def test_he2hb_q_reconstructs(self):
+        n = 24
+        a = spd(n, 8)
+        band, refl, taus = slate.he2hb(a)
+        Q = np.asarray(slate.he2hb_q(refl, taus))
+        # A = Q T Q^H
+        np.testing.assert_allclose(Q @ np.asarray(band) @ Q.conj().T, a,
+                                   rtol=1e-2, atol=1e-2)
+        np.testing.assert_allclose(Q @ Q.conj().T, np.eye(n), atol=1e-4)
+
+    def test_unmtr_he2hb_applies(self):
+        n = 16
+        a = spd(n, 9)
+        band, refl, taus = slate.he2hb(a)
+        C = rng(10).standard_normal((n, 3)).astype(np.float32)
+        out = slate.unmtr_he2hb("left", "n", refl, taus, C.copy())
+        Q = np.asarray(slate.he2hb_q(refl, taus))
+        np.testing.assert_allclose(np.asarray(out), Q @ C, rtol=1e-4, atol=1e-4)
+        out2 = slate.unmtr_he2hb("right", "c", refl, taus, C.T.copy())
+        np.testing.assert_allclose(np.asarray(out2), C.T @ Q.conj().T, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_hb2st_vectors_wide_band(self):
+        """Full eig pipeline on a wide band: band = Q2 T Q2^H."""
+        n, kd = 20, 3
+        a = spd(n, 11)
+        # build a Hermitian band matrix of bandwidth kd
+        band = np.triu(np.tril(a, kd), -kd).astype(np.float32)
+        d, e, Q2 = slate.hb2st(band, want_vectors=True)
+        T = np.diag(np.asarray(d)) + np.diag(np.asarray(e), 1) + np.diag(np.asarray(e), -1)
+        Q2 = np.asarray(Q2)
+        np.testing.assert_allclose(Q2 @ T @ Q2.conj().T, band, rtol=1e-2, atol=1e-2)
+
+    def test_full_two_stage_eig_pipeline(self):
+        """he2hb -> hb2st -> steqr -> unmtr_hb2st -> unmtr_he2hb == eigh."""
+        n = 24
+        a = spd(n, 12)
+        band, refl, taus = slate.he2hb(a)
+        d, e, Q2 = slate.hb2st(band, want_vectors=True)
+        lam, Z = slate.steqr(d, e)
+        Z = slate.unmtr_hb2st("left", "n", Q2, np.asarray(Z))
+        Z = slate.unmtr_he2hb("left", "n", refl, taus, np.asarray(Z))
+        Z = np.asarray(Z)
+        # A Z = Z diag(lam)
+        np.testing.assert_allclose(a @ Z, Z * np.asarray(lam)[None, :], rtol=1e-2,
+                                   atol=1e-2)
+        np.testing.assert_allclose(np.sort(np.asarray(lam)), np.linalg.eigvalsh(a),
+                                   rtol=1e-3)
+
+    def test_complex_two_stage(self):
+        n = 16
+        A, _ = matgen.generate_matrix("heev_geo", n, dtype=jnp.complex64,
+                                      cond=10.0, seed=13)
+        a = np.asarray(A)
+        band, refl, taus = slate.he2hb(a)
+        d, e, Q2 = slate.hb2st(np.asarray(band), want_vectors=True)
+        lam, Z = slate.steqr(d, e)
+        Z = np.asarray(slate.unmtr_hb2st("left", "n", Q2, np.asarray(Z).astype(np.complex64)))
+        Z = np.asarray(slate.unmtr_he2hb("left", "n", refl, taus, Z))
+        np.testing.assert_allclose(a @ Z, Z * np.asarray(lam)[None, :], rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_svd_back_transforms(self):
+        m, n = 20, 12
+        a = rng(14).standard_normal((m, n)).astype(np.float32)
+        d, e, U, VT = slate.ge2tb(a)
+        k = min(m, n)
+        B = np.zeros((k, k), np.float32)
+        B[np.arange(k), np.arange(k)] = np.asarray(d)
+        B[np.arange(k - 1), np.arange(1, k)] = np.asarray(e)
+        # A = U B VT
+        np.testing.assert_allclose(np.asarray(U) @ B @ np.asarray(VT), a,
+                                   rtol=1e-2, atol=1e-2)
+        C = rng(15).standard_normal((k, 2)).astype(np.float32)
+        out = slate.unmbr_ge2tb("left", "n", U, C.copy())
+        np.testing.assert_allclose(np.asarray(out), np.asarray(U) @ C, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_hb2st_vectors_batched(self):
+        """want_vectors must support the same batched input the plain path does."""
+        n = 6
+        bands = np.stack([np.diag(rng(s).standard_normal(n).astype(np.float32)) +
+                          np.diag(rng(s + 50).standard_normal(n - 1).astype(np.float32), -1)
+                          for s in (20, 21)])
+        d, e, Q2 = slate.hb2st(bands, want_vectors=True)
+        assert d.shape == (2, n) and e.shape == (2, n - 1) and Q2.shape == (2, n, n)
+        for k in range(2):
+            T = np.diag(np.asarray(d)[k]) + np.diag(np.asarray(e)[k], 1) + \
+                np.diag(np.asarray(e)[k], -1)
+            herm = np.tril(bands[k]) + np.tril(bands[k], -1).T
+            q = np.asarray(Q2)[k]
+            np.testing.assert_allclose(q @ T @ q.conj().T, herm, atol=1e-4)
+
+    def test_posv_mixed_gmres_nan_fallback(self):
+        """A matrix whose f32 Cholesky fails must fall back, not return NaN."""
+        n = 24
+        A, _ = matgen.generate_matrix("poev_geo", n, cond=1e12, seed=30,
+                                      dtype=jnp.float64)
+        a = np.asarray(A)
+        b = rng(31).standard_normal((n,))
+        X, info, iters = slate.posv_mixed_gmres(a, b.copy())
+        assert np.isfinite(np.asarray(X)).all()
+
+    def test_tb2bd_want_vectors_identity(self):
+        k = 8
+        b = np.diag(rng(16).standard_normal(k).astype(np.float32)) + \
+            np.diag(rng(17).standard_normal(k - 1).astype(np.float32), 1)
+        d, e, U2, VT2 = slate.tb2bd(b, kd=1, want_vectors=True)
+        np.testing.assert_allclose(np.asarray(U2), np.eye(k))
+        np.testing.assert_allclose(np.asarray(VT2), np.eye(k))
