@@ -351,7 +351,7 @@ def posv_mixed_gmres(A, B, opts=None, uplo=None):
     """SPD GMRES-IR: FGMRES in working precision, right-preconditioned by the
     low-precision Cholesky solve (src/posv_mixed_gmres.cc; single RHS like the
     reference). Returns (X, info, iters)."""
-    from .lu import _gmres_ir
+    from .lu import _gmres_ir, _require_single_rhs
 
     opts = Options.make(opts)
     the_uplo = uplo or (A.uplo if isinstance(A, BaseMatrix) and A.uplo != Uplo.General
@@ -359,6 +359,7 @@ def posv_mixed_gmres(A, B, opts=None, uplo=None):
     Af = _full_spd(A, None if isinstance(A, (HermitianMatrix, SymmetricMatrix))
                    else the_uplo)
     b = as_array(B)
+    _require_single_rhs(b, "posv_mixed_gmres")
     lo = opts.factor_precision or _lower_precision(Af.dtype)
     if lo is None:
         X, info = posv(A, B, opts, uplo)

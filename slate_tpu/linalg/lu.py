@@ -357,23 +357,23 @@ def gesv_nopiv(A, B, opts=None):
     return gesv(A, B, opts)
 
 
-def getri(A, opts=None):
-    """In-place inverse from LU (src/getri.cc, getriOOP.cc): solve A X = I."""
-    a = as_array(A)
-    n = a.shape[-1]
-    lu_, perm, info = getrf(A, opts)
-    X = getrs(lu_, perm, jnp.eye(n, dtype=a.dtype), opts)
-    return write_back(A, X), info
+def getri(LU, perm, opts=None):
+    """Inverse from the LU factor (src/getri.cc): solves A X = I against the
+    factored (LU, perm) pair from getrf, writing the inverse back over the
+    factor — the reference's in-place contract."""
+    lu_ = as_array(LU)
+    n = lu_.shape[-1]
+    X = getrs(lu_, perm, jnp.eye(n, dtype=lu_.dtype), opts)
+    return write_back(LU, X)
 
 
-def getri_oop(A, B, opts=None):
-    """Out-of-place inverse (src/getriOOP.cc): writes A^{-1} into B, leaving A
-    untouched — the reference offers this so the LU factor survives for reuse."""
-    a = as_array(A)
-    n = a.shape[-1]
-    lu_, perm, info = getrf(jnp.array(a), opts)   # factor a copy, not A itself
-    X = getrs(lu_, perm, jnp.eye(n, dtype=a.dtype), opts)
-    return write_back(B, X), info
+def getri_oop(LU, perm, B, opts=None):
+    """Out-of-place inverse (src/getriOOP.cc): writes A^{-1} into B from the
+    factored (LU, perm) pair, leaving the factor intact for reuse."""
+    lu_ = as_array(LU)
+    n = lu_.shape[-1]
+    X = getrs(lu_, perm, jnp.eye(n, dtype=lu_.dtype), opts)
+    return write_back(B, X)
 
 
 # ---------------------------------------------------------------------------
@@ -443,13 +443,20 @@ def _fgmres(matvec, precond, b, x0, restart, tol, max_restarts):
     return x, restarts
 
 
+def _require_single_rhs(b, routine: str):
+    """GMRES-IR drivers take one RHS like the reference — enforced up front, for
+    every dtype, so the contract doesn't depend on whether a lower precision
+    exists."""
+    if b.ndim != 1 and b.shape[-1] != 1:
+        raise SlateError(f"{routine} supports a single RHS (matches reference)")
+
+
 def _gmres_ir(matvec, precond, b, opts, routine: str):
-    """Shared GMRES-IR body for gesv_mixed_gmres / posv_mixed_gmres: single-RHS
-    validation, tolerance, restarted FGMRES, NaN-safe convergence verdict.
+    """Shared GMRES-IR body for gesv_mixed_gmres / posv_mixed_gmres: tolerance,
+    restarted FGMRES, NaN-safe convergence verdict.
     Returns (x shaped like b, restarts, converged)."""
     squeeze = b.ndim == 1
-    if not squeeze and b.shape[-1] != 1:
-        raise SlateError(f"{routine} supports a single RHS (matches reference)")
+    _require_single_rhs(b, routine)
     bv = b.reshape(-1) if not squeeze else b
     n = bv.shape[0]
     eps = jnp.finfo(bv.dtype).eps
@@ -471,6 +478,7 @@ def gesv_mixed_gmres(A, B, opts=None):
     opts = Options.make(opts)
     a = as_array(A)
     b = as_array(B)
+    _require_single_rhs(b, "gesv_mixed_gmres")
     lo = opts.factor_precision or _lower_precision(a.dtype)
     if lo is None:
         X, perm, info = gesv(A, B, opts)

@@ -166,13 +166,32 @@ def tb2bd(band, kd, opts=None, want_vectors: bool = False):
         d, e, U2, VT2 = ge2tb(b, opts)
         return (d, e, U2, VT2) if want_vectors else (d, e)
     k = min(b.shape[-2:])
-    d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))[:k]
-    e = jnp.real(jnp.diagonal(b, offset=1, axis1=-2, axis2=-1))[: k - 1]
+    d_c = jnp.diagonal(b, axis1=-2, axis2=-1)[:k]
+    e_c = jnp.diagonal(b, offset=1, axis1=-2, axis2=-1)[: k - 1]
+    if not jnp.issubdtype(b.dtype, jnp.complexfloating):
+        if not want_vectors:
+            return jnp.real(d_c), jnp.real(e_c)
+        m, n = b.shape[-2:]
+        return (jnp.real(d_c), jnp.real(e_c), jnp.eye(m, k, dtype=b.dtype),
+                jnp.eye(k, n, dtype=b.dtype))
+    # complex band: absorb diagonal/superdiagonal phases into unitary diagonals
+    # u, w with  B_c = diag(u) B_real diag(w)^T  (the LAPACK-style similarity):
+    #   u_j w_j = phase(d_j),  u_j w_{j+1} = phase(e_j)
+    # solved by  w_0 = 1,  u_j = pd_j / w_j,  w_{j+1} = w_j pd_j^* pe_j
+    def phase(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag > 0, x / jnp.where(mag > 0, mag, 1), 1).astype(b.dtype)
+
+    pd, pe = phase(d_c), phase(e_c)
+    w = jnp.concatenate([jnp.ones_like(pd[:1]),
+                         jnp.cumprod(jnp.conj(pd[:-1]) * pe)])
+    u = pd / w
+    d, e = jnp.abs(d_c), jnp.abs(e_c)
     if not want_vectors:
         return d, e
     m, n = b.shape[-2:]
-    U2 = jnp.eye(m, k, dtype=b.dtype)
-    VT2 = jnp.eye(k, n, dtype=b.dtype)
+    U2 = jnp.eye(m, k, dtype=b.dtype) * u[None, :]
+    VT2 = jnp.eye(k, n, dtype=b.dtype) * w[:, None]
     return d, e, U2, VT2
 
 

@@ -95,11 +95,7 @@ def eig_vals(A, opts=None, uplo=None):
 
 
 svd = _la.svd
-
-
-def svd_vals(A, opts=None):
-    """Singular values only."""
-    return _la.svd_vals(A, opts)
+svd_vals = _la.svd_vals
 
 
 # --- misc ---

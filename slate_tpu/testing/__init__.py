@@ -1,0 +1,12 @@
+"""Routine tester / parameter-sweep harness (≅ test/ + TestSweeper, SURVEY.md §4).
+
+Run as ``python -m slate_tpu.testing <routine> [flags]`` — the analogue of the
+reference's single ``tester`` binary with its routine dispatch table
+(test/test.cc:117-320).  ``tools/run_tests.py`` drives size-class sweeps on top.
+"""
+
+from .sweeper import ParamSweep, TestResult, format_table, parse_dims, parse_list
+from .routines import ROUTINES, run_routine
+
+__all__ = ["ParamSweep", "TestResult", "format_table", "parse_dims", "parse_list",
+           "ROUTINES", "run_routine"]

@@ -1,0 +1,92 @@
+"""Tester CLI: ``python -m slate_tpu.testing <routine|category|all> [flags]``.
+
+≅ the reference's ``tester`` binary (test/test.cc:654-663 main + dispatch table).
+Examples::
+
+    python -m slate_tpu.testing gemm --dim 128:512:128 --type s --nb 64
+    python -m slate_tpu.testing cholesky --dim 256 --type s,c
+    python -m slate_tpu.testing all --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .routines import ROUTINES, run_routine
+from .sweeper import DTYPES, ParamSweep, format_table, parse_dims, parse_list
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.testing",
+        description="slate_tpu routine tester (TestSweeper-style sweeps)")
+    ap.add_argument("routine",
+                    help="routine name, category (blas3/cholesky/lu/qr/eig/svd/"
+                         "band/indefinite/aux/condest), or 'all'")
+    ap.add_argument("--dim", default="128",
+                    help="dims: N | N1,N2 | start:stop:step | MxN | MxNxK")
+    ap.add_argument("--type", default="s", help="s,d,c,z (d/z need x64)")
+    ap.add_argument("--nb", default="64", help="tile sizes (comma list)")
+    ap.add_argument("--matrix", default="randn", dest="kind",
+                    help="matgen kind for general inputs")
+    ap.add_argument("--cond", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeat", type=int, default=1, help="timing repeats (best-of)")
+    ap.add_argument("--quick", action="store_true", help="small fixed sweep")
+    ap.add_argument("--list", action="store_true", help="list routines and exit")
+    return ap
+
+
+def select_routines(token: str):
+    if token == "all":
+        return sorted(ROUTINES)
+    if token in ROUTINES:
+        return [token]
+    cats = sorted(r for r, s in ROUTINES.items() if s["category"] == token)
+    if not cats:
+        raise SystemExit(f"unknown routine/category '{token}'; "
+                         f"known routines: {sorted(ROUTINES)}")
+    return cats
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list:
+        for name in sorted(ROUTINES):
+            print(f"{name:16s} {ROUTINES[name]['category']:12s}"
+                  f" {ROUTINES[name]['doc'].splitlines()[0] if ROUTINES[name]['doc'] else ''}")
+        return 0
+
+    dims = parse_dims("64,96" if args.quick else args.dim)
+    dtypes = parse_list(args.type)
+    unknown = [t for t in dtypes if t not in DTYPES]
+    if unknown:
+        raise SystemExit(f"unknown type letters {unknown}; use s,d,c,z")
+    if any(t in ("d", "z") for t in dtypes):
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+    results = []
+    for routine in select_routines(args.routine):
+        sweep = ParamSweep(dim=dims, dtype=dtypes,
+                           nb=[int(x) for x in parse_list(args.nb)])
+        for point in sweep:
+            m, n, k = point["dim"]
+            params = {"m": m, "n": n, "k": k, "nb": point["nb"],
+                      "dtype": DTYPES[point["dtype"]], "kind": args.kind,
+                      "cond": args.cond, "seed": args.seed, "repeat": args.repeat}
+            r = run_routine(routine, params)
+            # put the type letter back for display
+            r.params = dict(r.params, dtype=point["dtype"])
+            results.append(r)
+            row = format_table([r]).splitlines()[2]
+            print(row, flush=True)
+
+    print()
+    print(format_table(results).splitlines()[-1])
+    return 0 if all(r.ok for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

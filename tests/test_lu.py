@@ -88,10 +88,12 @@ def test_getrs_trans(rng):
 
 
 def test_getri(rng):
+    """getri consumes the (LU, perm) factor like the reference (src/getri.cc)."""
     n = 18
     a = _gen(rng, n, n)
     A = slate.Matrix.from_array(a.copy(), nb=6)
-    inv, info = linalg.getri(A)
+    lu_, perm, info = linalg.getrf(A)
+    inv = linalg.getri(lu_, perm)
     np.testing.assert_allclose(np.asarray(inv) @ a, np.eye(n), atol=1e-10)
 
 

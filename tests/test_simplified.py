@@ -69,15 +69,19 @@ class TestNewVariants:
         x = slate.getrs_nopiv(lu_, b.copy())
         np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-3)
 
-    def test_getri_oop_preserves_A(self):
+    def test_getri_oop_preserves_factor(self):
+        """Verb contract: *_using_factor consumes getrf's output (simplified_api.hh)."""
         n = 10
         a = rng(3).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
-        A = slate.Matrix.from_array(a.copy(), nb=4)
+        lu_, perm, info = s.lu_factor(a.copy())
+        lu_saved = np.asarray(lu_).copy()
         Out = slate.Matrix.from_array(np.zeros_like(a), nb=4)
-        inv, info = slate.getri_oop(A, Out)
-        np.testing.assert_array_equal(np.asarray(A.array), a)  # untouched
+        s.lu_inverse_using_factor_out_of_place(lu_, perm, Out)
+        np.testing.assert_array_equal(np.asarray(lu_), lu_saved)  # factor untouched
         np.testing.assert_allclose(a @ np.asarray(Out.array), np.eye(n),
                                    atol=1e-3)
+        inv = s.lu_inverse_using_factor(lu_, perm)
+        np.testing.assert_allclose(a @ np.asarray(inv), np.eye(n), atol=1e-3)
 
     def test_posv_mixed_gmres(self):
         n = 32
@@ -207,3 +211,30 @@ class TestBackTransforms:
         d, e, U2, VT2 = slate.tb2bd(b, kd=1, want_vectors=True)
         np.testing.assert_allclose(np.asarray(U2), np.eye(k))
         np.testing.assert_allclose(np.asarray(VT2), np.eye(k))
+
+    def test_tb2bd_complex_phases(self):
+        """Complex bidiagonal: band = U2 B_real VT2 must hold exactly (the phase
+        similarity), and (d, e) must be the magnitudes."""
+        k = 6
+        r = rng(18)
+        d_c = (r.standard_normal(k) + 1j * r.standard_normal(k)).astype(np.complex64)
+        e_c = (r.standard_normal(k - 1) + 1j * r.standard_normal(k - 1)).astype(np.complex64)
+        b = np.diag(d_c) + np.diag(e_c, 1)
+        d, e, U2, VT2 = slate.tb2bd(b, kd=1, want_vectors=True)
+        np.testing.assert_allclose(np.asarray(d), np.abs(d_c), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(e), np.abs(e_c), rtol=1e-6)
+        B = np.diag(np.asarray(d)) + np.diag(np.asarray(e), 1)
+        np.testing.assert_allclose(np.asarray(U2) @ B @ np.asarray(VT2), b,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gmres_single_rhs_contract_all_dtypes(self):
+        """Multi-RHS must raise for every dtype, not only when a lower precision
+        exists."""
+        from slate_tpu.core.exceptions import SlateError
+        n = 8
+        a = spd(n, 20)
+        b = rng(21).standard_normal((n, 3)).astype(np.float32)
+        with pytest.raises(SlateError):
+            slate.posv_mixed_gmres(a, b)
+        with pytest.raises(SlateError):
+            slate.gesv_mixed_gmres(a, b)

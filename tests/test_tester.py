@@ -1,0 +1,61 @@
+"""Tester-harness tests (sweeper grammar + dispatch; ≅ unit tests of TestSweeper use)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.testing import ROUTINES, run_routine
+from slate_tpu.testing.sweeper import (ParamSweep, TestResult, format_table,
+                                       parse_dims, parse_list)
+
+
+class TestSweeperGrammar:
+    def test_single_and_list(self):
+        assert parse_dims("256") == [(256, 256, 256)]
+        assert parse_dims("64,128") == [(64, 64, 64), (128, 128, 128)]
+
+    def test_range(self):
+        assert parse_dims("100:300:100") == [(100,) * 3, (200,) * 3, (300,) * 3]
+
+    def test_shapes(self):
+        assert parse_dims("100x50") == [(100, 50, 50)]
+        assert parse_dims("100x50x25") == [(100, 50, 25)]
+
+    def test_mixed(self):
+        dims = parse_dims("64,100x50")
+        assert dims == [(64, 64, 64), (100, 50, 50)]
+
+    def test_sweep_cartesian(self):
+        sweep = ParamSweep(a=[1, 2], b=["x", "y", "z"])
+        assert len(sweep) == 6
+        assert {(p["a"], p["b"]) for p in sweep} == {(i, c) for i in (1, 2)
+                                                    for c in "xyz"}
+
+    def test_table_formats(self):
+        r = TestResult("gemm", {"m": 8, "n": 8, "k": 8, "nb": 4, "dtype": "s"},
+                       error=1e-7, time_s=0.1, gflops=5.0)
+        out = format_table([r])
+        assert "gemm" in out and "pass" in out and "1 tests: 1 pass" in out
+
+
+class TestDispatch:
+    def test_inventory_covers_families(self):
+        cats = {spec["category"] for spec in ROUTINES.values()}
+        assert {"blas3", "cholesky", "lu", "qr", "eig", "svd", "band",
+                "indefinite"} <= cats
+
+    def test_unknown_routine_raises(self):
+        with pytest.raises(KeyError):
+            run_routine("nosuch", {})
+
+    @pytest.mark.parametrize("routine", ["gemm", "potrf", "getrf", "geqrf"])
+    def test_smoke(self, routine):
+        params = {"m": 48, "n": 48, "k": 48, "nb": 16, "dtype": np.float32,
+                  "kind": "randn", "cond": None, "seed": 0, "repeat": 1, "nrhs": 2}
+        r = run_routine(routine, params)
+        assert r.status == "pass", (r.status, r.message)
+        assert r.error is not None and r.time_s is not None
+
+    def test_runner_never_raises(self):
+        # bad params produce an 'error' row, not an exception (tester contract)
+        r = run_routine("gemm", {"m": 8})
+        assert r.status == "error"

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Sweep driver over the routine tester (≅ test/run_tests.py, 828 lines: size
+classes --quick/--xsmall/--small/--medium/--large, shape filters, per-routine
+timeout, JUnit XML for CI).
+
+Examples::
+
+    python tools/run_tests.py --quick
+    python tools/run_tests.py --small --categories blas3,cholesky --xml out.xml
+    python tools/run_tests.py --medium --routines gemm,posv --type s,c
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import xml.etree.ElementTree as ET
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# the tester must not hang on a wedged TPU tunnel: default to CPU unless the
+# caller explicitly set a platform (the bench path sets its own)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+from slate_tpu.testing import ROUTINES, run_routine          # noqa: E402
+from slate_tpu.testing.sweeper import DTYPES, parse_list     # noqa: E402
+
+SIZE_CLASSES = {
+    # dims per class (≅ run_tests.py size classes); nb chosen to exercise blocking
+    "quick":  {"dims": [64, 96], "nb": [32], "nrhs": 4},
+    "xsmall": {"dims": [128], "nb": [32, 64], "nrhs": 8},
+    "small":  {"dims": [256], "nb": [64], "nrhs": 8},
+    "medium": {"dims": [512, 768], "nb": [128], "nrhs": 16},
+    "large":  {"dims": [1024, 2048], "nb": [256], "nrhs": 16},
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    for cls in SIZE_CLASSES:
+        ap.add_argument(f"--{cls}", action="store_true")
+    ap.add_argument("--routines", default=None, help="comma list (default: all)")
+    ap.add_argument("--categories", default=None, help="comma list of categories")
+    ap.add_argument("--type", default="s", help="s,d,c,z")
+    ap.add_argument("--tall", action="store_true", help="tall shapes m = 2n")
+    ap.add_argument("--wide", action="store_true", help="wide shapes n = 2m")
+    ap.add_argument("--xml", default=None, help="write JUnit XML here")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cls = next((c for c in SIZE_CLASSES if getattr(args, c)), "quick")
+    cfg = SIZE_CLASSES[cls]
+
+    names = sorted(ROUTINES)
+    if args.routines:
+        names = [r for r in parse_list(args.routines) if r in ROUTINES]
+    if args.categories:
+        cats = set(parse_list(args.categories))
+        names = [r for r in names if ROUTINES[r]["category"] in cats]
+
+    dtypes = parse_list(args.type)
+    if any(t in ("d", "z") for t in dtypes):
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+    results = []
+    t0 = time.time()
+    for routine in names:
+        for d in cfg["dims"]:
+            m, n = d, d
+            if args.tall:
+                m = 2 * d
+            elif args.wide:
+                n = 2 * d
+            for nb in cfg["nb"]:
+                for tletter in dtypes:
+                    params = {"m": m, "n": n, "k": d, "nb": nb,
+                              "dtype": DTYPES[tletter], "kind": "randn",
+                              "cond": None, "seed": args.seed, "repeat": 1,
+                              "nrhs": cfg["nrhs"]}
+                    r = run_routine(routine, params)
+                    r.params = dict(r.params, dtype=tletter)
+                    results.append(r)
+                    status = r.status if r.ok else f"** {r.status} **"
+                    print(f"{routine:16s} {tletter} {m:5d}x{n:<5d} nb={nb:<4d} "
+                          f"err={r.error if r.error is not None else float('nan'):.2e} "
+                          f"{status} {r.message}", flush=True)
+
+    elapsed = time.time() - t0
+    npass = sum(1 for r in results if r.status == "pass")
+    nskip = sum(1 for r in results if r.status == "skipped")
+    nfail = len(results) - npass - nskip
+    print(f"\n[{cls}] {len(results)} tests: {npass} pass, {nfail} failed, "
+          f"{nskip} skipped in {elapsed:.1f}s")
+
+    if args.xml:
+        suite = ET.Element("testsuite", name=f"slate_tpu-{cls}",
+                           tests=str(len(results)), failures=str(nfail),
+                           skipped=str(nskip), time=f"{elapsed:.2f}")
+        for r in results:
+            p = r.params
+            case = ET.SubElement(
+                suite, "testcase",
+                classname=f"slate_tpu.{ROUTINES[r.routine]['category']}",
+                name=f"{r.routine}_{p.get('dtype')}_{p.get('m')}x{p.get('n')}"
+                     f"_nb{p.get('nb')}",
+                time=f"{r.time_s or 0:.4f}")
+            if r.status == "skipped":
+                ET.SubElement(case, "skipped", message=r.message)
+            elif r.status != "pass":
+                ET.SubElement(case, "failure", message=r.message or r.status)
+        ET.ElementTree(suite).write(args.xml, encoding="unicode",
+                                    xml_declaration=True)
+        print(f"wrote {args.xml}")
+
+    return 0 if nfail == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
