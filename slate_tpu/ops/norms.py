@@ -20,6 +20,18 @@ import jax.numpy as jnp
 from ..core.exceptions import SlateError
 from ..core.types import Diag, Norm, NormScope, Uplo
 from .elementwise import _mask
+from . import pallas_norms as _pk
+
+#: route 2-D unbatched norms through the Pallas streaming kernels on TPU
+#: (set False to force the plain XLA reductions; tests cover both paths)
+USE_PALLAS = True
+
+_PK_WHICH = {Norm.Max: "max", Norm.One: "one", Norm.Inf: "inf", Norm.Fro: "fro"}
+
+
+def _pallas_ok(A) -> bool:
+    return (USE_PALLAS and _pk.available() and getattr(A, "ndim", 0) == 2
+            and jax.default_backend() == "tpu")
 
 
 def _abs(A):
@@ -34,11 +46,15 @@ def genorm(norm, A, scope=NormScope.Matrix):
     """
     norm = Norm.from_string(norm)
     scope = NormScope.from_string(scope) if not isinstance(scope, NormScope) else scope
-    a = _abs(A)
     if scope == NormScope.Columns:
         if norm != Norm.Max:
             raise SlateError("colNorms supports Norm.Max only (matches reference)")
-        return jnp.max(a, axis=-2)
+        if _pallas_ok(A):
+            return _pk.col_norms_max(A)
+        return jnp.max(_abs(A), axis=-2)
+    if _pallas_ok(A) and norm in _PK_WHICH:
+        return _pk.genorm(A, _PK_WHICH[norm])
+    a = _abs(A)
     if norm == Norm.Max:
         return jnp.max(a)
     if norm == Norm.One:
@@ -60,7 +76,16 @@ def _masked(A, uplo, diag=Diag.NonUnit):
 
 
 def trnorm(norm, uplo, diag, A):
-    """Trapezoid/triangular norm (internal_trnorm.cc, device_trnorm.cu)."""
+    """Trapezoid/triangular norm (internal_trnorm.cc, device_trnorm.cu).
+
+    On TPU the triangle mask is applied in-register inside the Pallas kernel
+    instead of materializing the masked matrix in HBM."""
+    which = _PK_WHICH.get(Norm.from_string(norm))
+    if _pallas_ok(A) and which is not None:
+        lower = Uplo.from_string(uplo) == Uplo.Lower
+        mode = _pk._MODE_LOWER if lower else _pk._MODE_UPPER
+        return _pk.genorm(A, which, mode=mode,
+                          unit_diag=Diag.from_string(diag) == Diag.Unit)
     return genorm(norm, _masked(A, uplo, diag))
 
 

@@ -1,0 +1,247 @@
+"""Pallas TPU kernels for the norm family (max / one / inf / fro, with scopes and
+triangle masks).
+
+Reference analogue: the hand-written CUDA reductions ``src/cuda/device_genorm.cu``,
+``device_{he,sy,tr}norm.cu`` and their batch wrappers — the one kernel family the
+survey marks as deserving real custom kernels on TPU (SURVEY.md §2.5): a norm is a
+pure reduction, so XLA materializes |A| (an extra HBM round-trip) unless fused;
+the Pallas kernel streams each (block_rows x block_cols) tile through VMEM once,
+computing |.|, triangle masking, and the partial reduction in registers, and
+accumulates across the sequential TPU grid — the same structure as the reference's
+per-tile partial-norm kernels plus host combine.
+
+The grid is 2-D (row blocks x col blocks) so VMEM stays bounded (~2 MB/block) for
+any matrix shape; TPU executes the grid sequentially with the last dimension
+innermost, which the accumulation predicates rely on.  Zero padding is safe for
+every reduction here (|0| contributes nothing to max of abs, sums, or squares).
+
+On non-TPU backends the same kernels run through the Pallas interpreter
+(``interpret=True``) so CPU tests exercise the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (present in all jax>=0.4.3x installs)
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - environment-specific
+    pltpu = None
+    _HAS_PALLAS = False
+
+_LANE = 128          # TPU lane width: last dim must be a multiple
+_BM = 256            # row-block
+_BN = 2048           # col-block: 256x2048 f32 = 2 MB of VMEM per buffer
+
+# mask modes (static kernel parameter)
+_MODE_GE = 0         # no mask
+_MODE_LOWER = 1      # keep r >= c
+_MODE_UPPER = 2      # keep r <= c
+_MODE_LOWER_STRICT = 3   # keep r > c
+_MODE_UPPER_STRICT = 4   # keep r < c
+
+
+def available() -> bool:
+    return _HAS_PALLAS
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ceil_mult(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _pad2(a: jax.Array, bm: int, bn: int):
+    """Zero-pad both dims up to block multiples (last dim also lane-aligned)."""
+    m, n = a.shape
+    pm = _ceil_mult(m, bm)
+    pn = _ceil_mult(max(n, _LANE), bn if bn % _LANE == 0 else _ceil_mult(bn, _LANE))
+    if (pm, pn) != (m, n):
+        a = jnp.pad(a, ((0, pm - m), (0, pn - n)))
+    return a, pm, pn
+
+
+def _block_abs(ref, mode: int, unit_diag: bool, i, j, bm: int, bn: int,
+               m_valid: int, n_valid: int):
+    """|block| with the triangle mask applied in-register (device_trnorm.cu's
+    masked read). Row/col ids are global via the block offsets; the valid extents
+    keep zero padding out of upper-triangle and unit-diagonal fills."""
+    x = jnp.abs(ref[...])
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    cols = j * bn + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    if mode == _MODE_LOWER:
+        keep = rows >= cols
+    elif mode == _MODE_UPPER:
+        keep = (rows <= cols) & (cols < n_valid)
+    elif mode == _MODE_LOWER_STRICT:
+        keep = rows > cols
+    elif mode == _MODE_UPPER_STRICT:
+        keep = (rows < cols) & (cols < n_valid)
+    else:
+        keep = None
+    if keep is not None:
+        x = jnp.where(keep, x, 0)
+    if unit_diag:
+        x = jnp.where((rows == cols) & (rows < min(m_valid, n_valid)), 1.0, x)
+    return x
+
+
+def _real(dtype):
+    return jnp.zeros((), dtype).real.dtype
+
+
+def _blocks(bm, bn):
+    return max(8, min(bm, _BM)), max(_LANE, min(_ceil_mult(bn, _LANE), _BN))
+
+
+def _scalar_reduce(a, mode, unit_diag, combine, block_fn):
+    """Whole-matrix scalar reduction into SMEM (max / sum-of-squares)."""
+    rdt = _real(a.dtype)
+    m, n = a.shape
+    bm, bn = _blocks(m, n)
+    a_p, pm, pn = _pad2(a, bm, bn)
+
+    def kernel(in_ref, out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        x = _block_abs(in_ref, mode, unit_diag, i, j, bm, bn, m, n).astype(rdt)
+        part = block_fn(x)
+
+        @pl.when((i == 0) & (j == 0))
+        def _():
+            out_ref[0, 0] = part
+
+        @pl.when((i > 0) | (j > 0))
+        def _():
+            out_ref[0, 0] = combine(out_ref[0, 0], part)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(pm // bm, pn // bn),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.SMEM) if not _interpret()
+                   else pl.BlockSpec((1, 1), lambda i, j: (0, 0))),
+        out_shape=jax.ShapeDtypeStruct((1, 1), rdt),
+        interpret=_interpret(),
+    )(a_p)
+    return out[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "unit_diag"))
+def max_norm(a: jax.Array, mode: int = _MODE_GE,
+             unit_diag: bool = False) -> jax.Array:
+    """max |a_ij| over the (masked) matrix — one streaming pass."""
+    return _scalar_reduce(a, mode, unit_diag, jnp.maximum, jnp.max)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "unit_diag"))
+def sumsq(a: jax.Array, mode: int = _MODE_GE,
+          unit_diag: bool = False) -> jax.Array:
+    """sum |a_ij|^2 (fro-norm partial) — scalar SMEM accumulation."""
+    return _scalar_reduce(a, mode, unit_diag, jnp.add, lambda x: jnp.sum(x * x))
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "unit_diag", "op"))
+def col_reduce(a: jax.Array, mode: int = _MODE_GE, unit_diag: bool = False,
+               op: str = "sum") -> jax.Array:
+    """Per-column reduction over row blocks: op='sum' -> column sums of |a|
+    (one-norm partials); 'max' -> column maxes (colNorms); 'sumsq' -> sums of
+    |a|^2 (fro partials).  Returns the length-n vector."""
+    rdt = _real(a.dtype)
+    m, n = a.shape
+    bm, bn = _blocks(m, n)
+    a_p, pm, pn = _pad2(a, bm, bn)
+
+    # the reduced (row) dimension must be the INNERMOST grid dim so consecutive
+    # grid steps keep revisiting the same output block (TPU pipelining flushes an
+    # output block when its index changes — the standard K-innermost accumulation
+    # rule)
+    def kernel(in_ref, out_ref):
+        j, i = pl.program_id(0), pl.program_id(1)
+        x = _block_abs(in_ref, mode, unit_diag, i, j, bm, bn, m, n).astype(rdt)
+        if op == "sumsq":
+            x = x * x
+        part = (jnp.max(x, axis=0, keepdims=True) if op == "max"
+                else jnp.sum(x, axis=0, keepdims=True))
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[...] = part
+
+        @pl.when(i > 0)
+        def _():
+            if op == "max":
+                out_ref[...] = jnp.maximum(out_ref[...], part)
+            else:
+                out_ref[...] = out_ref[...] + part
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(pn // bn, pm // bm),
+        in_specs=[pl.BlockSpec((bm, bn), lambda j, i: (i, j))],
+        out_specs=pl.BlockSpec((1, bn), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, pn), rdt),
+        interpret=_interpret(),
+    )(a_p)
+    return out[0, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "unit_diag"))
+def row_sums(a: jax.Array, mode: int = _MODE_GE,
+             unit_diag: bool = False) -> jax.Array:
+    """Per-row sums of |a| (inf-norm partials), accumulated across col blocks."""
+    rdt = _real(a.dtype)
+    m, n = a.shape
+    bm, bn = _blocks(m, n)
+    a_p, pm, pn = _pad2(a, bm, bn)
+
+    def kernel(in_ref, out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        x = _block_abs(in_ref, mode, unit_diag, i, j, bm, bn, m, n).astype(rdt)
+        part = jnp.sum(x, axis=1, keepdims=True)
+
+        @pl.when(j == 0)
+        def _():
+            out_ref[...] = part
+
+        @pl.when(j > 0)
+        def _():
+            out_ref[...] = out_ref[...] + part
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(pm // bm, pn // bn),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((pm, 1), rdt),
+        interpret=_interpret(),
+    )(a_p)
+    return out[:m, 0]
+
+
+def genorm(a: jax.Array, which: str, mode: int = _MODE_GE,
+           unit_diag: bool = False) -> jax.Array:
+    """Full norm via the streaming kernels (general or triangle-masked).
+
+    which: max | one | inf | fro.  Scalar result.
+    """
+    if which == "max":
+        return max_norm(a, mode, unit_diag)
+    if which == "one":
+        return jnp.max(col_reduce(a, mode, unit_diag, op="sum"))
+    if which == "inf":
+        return jnp.max(row_sums(a, mode, unit_diag))
+    if which == "fro":
+        return jnp.sqrt(sumsq(a, mode, unit_diag))
+    raise ValueError(f"unknown norm '{which}'")
+
+
+def col_norms_max(a: jax.Array) -> jax.Array:
+    """colNorms(Max) — vector of column max-norms (src/colNorms.cc)."""
+    return col_reduce(a, op="max")

@@ -4,7 +4,7 @@
 Examples::
 
     python -m slate_tpu.testing gemm --dim 128:512:128 --type s --nb 64
-    python -m slate_tpu.testing cholesky --dim 256 --type s,c
+    python -m slate_tpu.testing cholesky --dim 256 --type s,c --ref
     python -m slate_tpu.testing all --quick
 """
 
@@ -13,8 +13,9 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .routines import ROUTINES, run_routine
-from .sweeper import DTYPES, ParamSweep, format_table, parse_dims, parse_list
+from .driver import run_sweep
+from .routines import ROUTINES
+from .sweeper import DTYPES, format_table, parse_dims, parse_list
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -33,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--cond", type=float, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--repeat", type=int, default=1, help="timing repeats (best-of)")
+    ap.add_argument("--ref", action="store_true",
+                    help="also time the numpy reference (ref(s) column)")
     ap.add_argument("--quick", action="store_true", help="small fixed sweep")
     ap.add_argument("--list", action="store_true", help="list routines and exit")
     return ap
@@ -63,28 +66,18 @@ def main(argv=None) -> int:
     unknown = [t for t in dtypes if t not in DTYPES]
     if unknown:
         raise SystemExit(f"unknown type letters {unknown}; use s,d,c,z")
-    if any(t in ("d", "z") for t in dtypes):
-        import jax
-        jax.config.update("jax_enable_x64", True)
 
-    results = []
-    for routine in select_routines(args.routine):
-        sweep = ParamSweep(dim=dims, dtype=dtypes,
-                           nb=[int(x) for x in parse_list(args.nb)])
-        for point in sweep:
-            m, n, k = point["dim"]
-            params = {"m": m, "n": n, "k": k, "nb": point["nb"],
-                      "dtype": DTYPES[point["dtype"]], "kind": args.kind,
-                      "cond": args.cond, "seed": args.seed, "repeat": args.repeat}
-            r = run_routine(routine, params)
-            # put the type letter back for display
-            r.params = dict(r.params, dtype=point["dtype"])
-            results.append(r)
-            row = format_table([r]).splitlines()[2]
-            print(row, flush=True)
+    def progress(r):
+        print(f"  {r.routine} {r.params.get('dtype')} "
+              f"{r.params['m']}x{r.params['n']} nb={r.params['nb']}: {r.status}",
+              flush=True)
 
+    results = run_sweep(select_routines(args.routine), dims, dtypes,
+                        [int(x) for x in parse_list(args.nb)],
+                        kind=args.kind, cond=args.cond, seed=args.seed,
+                        repeat=args.repeat, ref=args.ref, progress=progress)
     print()
-    print(format_table(results).splitlines()[-1])
+    print(format_table(results))
     return 0 if all(r.ok for r in results) else 1
 
 

@@ -1,0 +1,84 @@
+"""Shared sweep-execution driver used by both CLIs (``python -m slate_tpu.testing``
+and ``tools/run_tests.py``) so the parameter schema lives in exactly one place."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .routines import ROUTINES, run_routine
+from .sweeper import DTYPES, TestResult
+
+# numpy reference timings for --ref (≅ the reference's ScaLAPACK comparison path:
+# run the same problem through the host reference library and report its time)
+_REF_FNS = {
+    "gemm": lambda p, r: r.standard_normal((p["m"], p["k"])) @
+                         r.standard_normal((p["k"], p["n"])),
+    "potrf": lambda p, r: np.linalg.cholesky(_ref_spd(p, r)),
+    "posv": lambda p, r: np.linalg.solve(_ref_spd(p, r),
+                                         r.standard_normal((p["n"], 2))),
+    "gesv": lambda p, r: np.linalg.solve(
+        r.standard_normal((p["n"], p["n"])) + p["n"] * np.eye(p["n"]),
+        r.standard_normal((p["n"], 2))),
+    "geqrf": lambda p, r: np.linalg.qr(r.standard_normal((p["m"], p["n"]))),
+    "heev": lambda p, r: np.linalg.eigh(_ref_spd(p, r)),
+    "svd": lambda p, r: np.linalg.svd(r.standard_normal((p["m"], p["n"]))),
+}
+
+
+def _ref_spd(p, r):
+    g = r.standard_normal((p["n"], p["n"]))
+    return g @ g.T + p["n"] * np.eye(p["n"])
+
+
+def _ref_time(routine: str, params: dict) -> Optional[float]:
+    fn = _REF_FNS.get(routine)
+    if fn is None:
+        return None
+    r = np.random.default_rng(params["seed"])
+    t0 = time.perf_counter()
+    fn(params, r)
+    return time.perf_counter() - t0
+
+
+def enable_x64_if_needed(dtypes: Sequence[str]) -> None:
+    if any(t in ("d", "z") for t in dtypes):
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+
+def run_sweep(names: Sequence[str],
+              dims: Sequence[Tuple[int, int, int]],
+              dtypes: Sequence[str],
+              nbs: Sequence[int],
+              *,
+              kind: str = "randn",
+              cond: Optional[float] = None,
+              seed: int = 0,
+              repeat: int = 1,
+              nrhs: int = 8,
+              ref: bool = False,
+              progress: Optional[Callable[[TestResult], None]] = None
+              ) -> List[TestResult]:
+    """Run the cartesian sweep; dtype letters are restored into each result's
+    params for display.  ``ref`` also times the numpy reference (where mapped)."""
+    enable_x64_if_needed(dtypes)
+    results: List[TestResult] = []
+    for routine in names:
+        for (m, n, k) in dims:
+            for nb in nbs:
+                for tletter in dtypes:
+                    params = {"m": m, "n": n, "k": k, "nb": nb,
+                              "dtype": DTYPES[tletter], "kind": kind,
+                              "cond": cond, "seed": seed, "repeat": repeat,
+                              "nrhs": nrhs}
+                    r = run_routine(routine, params)
+                    if ref and r.ok:
+                        r.ref_time_s = _ref_time(routine, params)
+                    r.params = dict(r.params, dtype=tletter)
+                    results.append(r)
+                    if progress is not None:
+                        progress(r)
+    return results

@@ -5,8 +5,9 @@ Each runner follows the reference's test strategy (SURVEY.md §4): generate inpu
 with matgen, time the library call, then verify with a **residual identity that
 needs no reference implementation** — gemm via the random-RHS trick
 (test_gemm.cc:192-207), factorizations via reconstruction (‖A − LLᴴ‖-style), eig/svd
-via ‖AZ − ZΛ‖ + orthogonality of Z.  ``--ref`` additionally compares against
-numpy/scipy on the gathered matrix (the analogue of the ScaLAPACK reference path).
+via ‖AZ − ZΛ‖ + orthogonality of Z.  ``--ref`` additionally times the numpy
+reference on the same problem (driver._REF_FNS — the analogue of the ScaLAPACK
+reference path, reported in the ref(s) column).
 """
 
 from __future__ import annotations
@@ -216,7 +217,6 @@ def run_potrf(p, slate):
     """‖A − L Lᴴ‖/‖A‖ reconstruction check."""
     n = p["n"]
     A = _spd(n, p)
-    M = slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(), nb=p["nb"])
     (L, info), t = time_call(lambda: slate.potrf(
         slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(), nb=p["nb"])),
         repeat=p["repeat"])

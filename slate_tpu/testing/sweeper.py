@@ -112,19 +112,25 @@ def time_call(fn, *args, repeat: int = 1, **kw) -> Tuple[Any, float]:
 
 
 def _block(x):
+    """Synchronize anything a runner can return: jax arrays, tuples of them, and
+    result dataclasses (e.g. geqrf's TriangularFactors) whose fields hold arrays."""
     if hasattr(x, "block_until_ready"):
         x.block_until_ready()
     elif isinstance(x, (tuple, list)):
         for item in x:
             _block(item)
+    elif hasattr(x, "__dict__"):
+        for v in vars(x).values():
+            _block(v)
 
 
 _COLUMNS = ["routine", "type", "m", "n", "k", "nb", "extra", "error", "time(s)",
-            "gflops", "status"]
+            "gflops", "ref(s)", "status"]
 
 
 def format_table(results: Iterable[TestResult]) -> str:
     """Fixed-width results table + summary line (the tester's stdout shape)."""
+    results = list(results)       # the Iterable is walked twice (rows + summary)
     rows = []
     for r in results:
         p = r.params
@@ -137,6 +143,7 @@ def format_table(results: Iterable[TestResult]) -> str:
             f"{r.error:.2e}" if r.error is not None else "-",
             f"{r.time_s:.4f}" if r.time_s is not None else "-",
             f"{r.gflops:.1f}" if r.gflops is not None else "-",
+            f"{r.ref_time_s:.4f}" if r.ref_time_s is not None else "-",
             r.status + (f" ({r.message})" if r.message and r.status != "pass" else ""),
         ])
     widths = [max(len(_COLUMNS[i]), *(len(row[i]) for row in rows)) if rows
@@ -145,7 +152,6 @@ def format_table(results: Iterable[TestResult]) -> str:
     lines.append("  ".join("-" * w for w in widths))
     for row in rows:
         lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
-    results = list(results)
     npass = sum(1 for r in results if r.status == "pass")
     nskip = sum(1 for r in results if r.status == "skipped")
     nfail = len(results) - npass - nskip

@@ -1,0 +1,109 @@
+"""Pallas norm kernels vs numpy (interpret mode on CPU — identical kernel code to
+the compiled TPU path; ≅ unit_test/test_Tile_kernels.cc for device_genorm etc.)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from slate_tpu.ops import pallas_norms as pn
+
+
+def npa(x):
+    return np.asarray(x)
+
+
+@pytest.fixture
+def a():
+    r = np.random.default_rng(0)
+    return r.standard_normal((300, 200)).astype(np.float32)
+
+
+class TestGenorm:
+    def test_all_norms(self, a):
+        x = jnp.asarray(a)
+        np.testing.assert_allclose(float(pn.genorm(x, "max")), np.abs(a).max(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(pn.genorm(x, "one")),
+                                   np.abs(a).sum(0).max(), rtol=1e-5)
+        np.testing.assert_allclose(float(pn.genorm(x, "inf")),
+                                   np.abs(a).sum(1).max(), rtol=1e-5)
+        np.testing.assert_allclose(float(pn.genorm(x, "fro")),
+                                   np.linalg.norm(a), rtol=1e-5)
+
+    def test_unaligned_shapes(self):
+        # shapes far from the lane/sublane multiples exercise the zero padding
+        for shape in [(5, 3), (1, 129), (257, 131), (8, 8)]:
+            r = np.random.default_rng(sum(shape))
+            a = r.standard_normal(shape).astype(np.float32)
+            x = jnp.asarray(a)
+            np.testing.assert_allclose(float(pn.genorm(x, "one")),
+                                       np.abs(a).sum(0).max(), rtol=1e-5)
+            np.testing.assert_allclose(float(pn.genorm(x, "inf")),
+                                       np.abs(a).sum(1).max(), rtol=1e-5)
+
+    def test_complex(self):
+        r = np.random.default_rng(1)
+        a = (r.standard_normal((64, 48)) + 1j * r.standard_normal((64, 48))
+             ).astype(np.complex64)
+        x = jnp.asarray(a)
+        np.testing.assert_allclose(float(pn.genorm(x, "fro")), np.linalg.norm(a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(pn.genorm(x, "max")), np.abs(a).max(),
+                                   rtol=1e-6)
+
+    def test_unknown_raises(self, a):
+        with pytest.raises(ValueError):
+            pn.genorm(jnp.asarray(a), "two")
+
+
+class TestMasked:
+    def test_lower_upper(self, a):
+        x = jnp.asarray(a)
+        np.testing.assert_allclose(
+            float(pn.genorm(x, "one", mode=pn._MODE_LOWER)),
+            np.abs(np.tril(a)).sum(0).max(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(pn.genorm(x, "fro", mode=pn._MODE_UPPER)),
+            np.linalg.norm(np.triu(a)), rtol=1e-5)
+
+    def test_strict_modes(self, a):
+        x = jnp.asarray(a)
+        np.testing.assert_allclose(
+            float(pn.genorm(x, "max", mode=pn._MODE_LOWER_STRICT)),
+            np.abs(np.tril(a, -1)).max(), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(pn.genorm(x, "inf", mode=pn._MODE_UPPER_STRICT)),
+            np.abs(np.triu(a, 1)).sum(1).max(), rtol=1e-5)
+
+    def test_unit_diag(self):
+        r = np.random.default_rng(2)
+        a = r.standard_normal((40, 40)).astype(np.float32)
+        ref = np.tril(a)
+        np.fill_diagonal(ref, 1.0)
+        got = float(pn.genorm(jnp.asarray(a), "one", mode=pn._MODE_LOWER,
+                              unit_diag=True))
+        np.testing.assert_allclose(got, np.abs(ref).sum(0).max(), rtol=1e-5)
+
+    def test_unit_diag_rect_padding(self):
+        """Unit diagonal must stop at min(m, n), not run into the padding."""
+        a = np.zeros((3, 200), np.float32)
+        got = float(pn.genorm(jnp.asarray(a), "max", mode=pn._MODE_LOWER,
+                              unit_diag=True))
+        assert got == 1.0   # only the 3 real diagonal entries are set
+
+
+class TestColNorms:
+    def test_matches_numpy(self, a):
+        got = npa(pn.col_norms_max(jnp.asarray(a)))
+        np.testing.assert_allclose(got, np.abs(a).max(0), rtol=1e-6)
+
+
+class TestDispatchIntegration:
+    def test_norms_layer_uses_jnp_on_cpu(self, a):
+        """On CPU the public norm path must not enter pallas (interpret is slow);
+        results agree either way."""
+        from slate_tpu.ops import norms
+        assert not norms._pallas_ok(jnp.asarray(a))
+        np.testing.assert_allclose(float(norms.genorm("fro", jnp.asarray(a))),
+                                   np.linalg.norm(a), rtol=1e-5)

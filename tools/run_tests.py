@@ -7,7 +7,7 @@ Examples::
 
     python tools/run_tests.py --quick
     python tools/run_tests.py --small --categories blas3,cholesky --xml out.xml
-    python tools/run_tests.py --medium --routines gemm,posv --type s,c
+    python tools/run_tests.py --medium --routines gemm,posv --type s,c --ref
 """
 
 from __future__ import annotations
@@ -25,8 +25,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
 
-from slate_tpu.testing import ROUTINES, run_routine          # noqa: E402
-from slate_tpu.testing.sweeper import DTYPES, parse_list     # noqa: E402
+from slate_tpu.testing import ROUTINES                          # noqa: E402
+from slate_tpu.testing.driver import run_sweep                  # noqa: E402
+from slate_tpu.testing.sweeper import parse_list                # noqa: E402
 
 SIZE_CLASSES = {
     # dims per class (≅ run_tests.py size classes); nb chosen to exercise blocking
@@ -47,6 +48,7 @@ def main(argv=None) -> int:
     ap.add_argument("--type", default="s", help="s,d,c,z")
     ap.add_argument("--tall", action="store_true", help="tall shapes m = 2n")
     ap.add_argument("--wide", action="store_true", help="wide shapes n = 2m")
+    ap.add_argument("--ref", action="store_true", help="time numpy reference too")
     ap.add_argument("--xml", default=None, help="write JUnit XML here")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
@@ -61,35 +63,28 @@ def main(argv=None) -> int:
         cats = set(parse_list(args.categories))
         names = [r for r in names if ROUTINES[r]["category"] in cats]
 
-    dtypes = parse_list(args.type)
-    if any(t in ("d", "z") for t in dtypes):
-        import jax
-        jax.config.update("jax_enable_x64", True)
+    dims = []
+    for d in cfg["dims"]:
+        m, n = d, d
+        if args.tall:
+            m = 2 * d
+        elif args.wide:
+            n = 2 * d
+        dims.append((m, n, d))
 
-    results = []
+    def progress(r):
+        status = r.status if r.ok else f"** {r.status} **"
+        err = r.error if r.error is not None else float("nan")
+        print(f"{r.routine:16s} {r.params.get('dtype')} "
+              f"{r.params['m']:5d}x{r.params['n']:<5d} nb={r.params['nb']:<4d} "
+              f"err={err:.2e} {status} {r.message}", flush=True)
+
     t0 = time.time()
-    for routine in names:
-        for d in cfg["dims"]:
-            m, n = d, d
-            if args.tall:
-                m = 2 * d
-            elif args.wide:
-                n = 2 * d
-            for nb in cfg["nb"]:
-                for tletter in dtypes:
-                    params = {"m": m, "n": n, "k": d, "nb": nb,
-                              "dtype": DTYPES[tletter], "kind": "randn",
-                              "cond": None, "seed": args.seed, "repeat": 1,
-                              "nrhs": cfg["nrhs"]}
-                    r = run_routine(routine, params)
-                    r.params = dict(r.params, dtype=tletter)
-                    results.append(r)
-                    status = r.status if r.ok else f"** {r.status} **"
-                    print(f"{routine:16s} {tletter} {m:5d}x{n:<5d} nb={nb:<4d} "
-                          f"err={r.error if r.error is not None else float('nan'):.2e} "
-                          f"{status} {r.message}", flush=True)
-
+    results = run_sweep(names, dims, parse_list(args.type), cfg["nb"],
+                        seed=args.seed, nrhs=cfg["nrhs"], ref=args.ref,
+                        progress=progress)
     elapsed = time.time() - t0
+
     npass = sum(1 for r in results if r.status == "pass")
     nskip = sum(1 for r in results if r.status == "skipped")
     nfail = len(results) - npass - nskip
