@@ -34,6 +34,7 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
                      unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)
 from . import simplified
 from . import matgen
+from . import native
 from .matgen import generate_matrix
 
 try:

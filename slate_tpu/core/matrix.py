@@ -49,7 +49,8 @@ class MatrixStorage:
     ``shared_ptr<MatrixStorage>``).
     """
 
-    __slots__ = ("array", "mb", "nb", "tile_rank", "grid", "kind", "p", "q", "order")
+    __slots__ = ("array", "mb", "nb", "tile_rank", "grid", "kind", "p", "q",
+                 "order", "default_rank_map")
 
     def __init__(self, array: jax.Array, mb: int, nb: int,
                  p: int = 1, q: int = 1, order: GridOrder = GridOrder.Col,
@@ -61,6 +62,9 @@ class MatrixStorage:
         self.p = int(p)
         self.q = int(q)
         self.order = GridOrder.from_string(order)
+        # custom lambdas disable the native owner-map fast path (which rebuilds
+        # the default 2D block-cyclic map from (order, p, q) only)
+        self.default_rank_map = tile_rank is None
         self.tile_rank = tile_rank or grid_funcs.process_2d_grid(self.order, self.p, self.q)
         self.grid = grid          # ProcessGrid (parallel/mesh.py) or None
         self.kind = kind
@@ -164,6 +168,32 @@ class BaseMatrix:
     def gridinfo(self) -> Tuple[GridOrder, int, int]:
         """(order, p, q) of the process grid (BaseMatrix.hh:161-164)."""
         return self.storage.order, self.storage.p, self.storage.q
+
+    def owner_map(self):
+        """(mt, nt) int32 array of tile owners — the materialized tile directory
+        (MatrixStorage.hh's map).  Root views use the native runtime's fast fill
+        (slate_tpu/native.py; numpy fallback); transposed/offset views go through
+        tileRank so the view semantics stay exact."""
+        import numpy as np
+        from .. import native
+        if (self.op == Op.NoTrans and self.ioffset == 0 and self.joffset == 0
+                and self.storage.default_rank_map):
+            order, p, q = self.gridinfo()
+            return native.owner_map(self.mt, self.nt, p, q, order)
+        return np.array([[self.tileRank(i, j) for j in range(self.nt)]
+                         for i in range(self.mt)], dtype=np.int32)
+
+    def local_tiles(self, rank: int):
+        """(k, 2) tile indices owned by ``rank`` (the per-rank directory walk the
+        reference does when enumerating local tiles)."""
+        import numpy as np
+        from .. import native
+        if (self.op == Op.NoTrans and self.ioffset == 0 and self.joffset == 0
+                and self.storage.default_rank_map):
+            order, p, q = self.gridinfo()
+            return native.local_tiles(self.mt, self.nt, p, q, rank, order)
+        ii, jj = np.nonzero(self.owner_map() == rank)
+        return np.stack([ii, jj], axis=1).astype(np.int64)
 
     # ----- data access ---------------------------------------------------------
     @property

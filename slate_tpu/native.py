@@ -1,0 +1,273 @@
+"""ctypes bindings for the native host runtime (native/slate_rt.cpp), with pure
+Python fallbacks.
+
+Reference analogue: the reference's C++ runtime layer — block-cyclic tile maps
+(func.hh), the tile directory (MatrixStorage.hh), the fixed-block memory pool
+(src/core/Memory.cc) and trace capture (src/auxiliary/Trace.cc).  The TPU compute
+path is XLA/Pallas; this is the *host* side: integer-heavy owner-map/plan
+computation, workspace accounting, and low-overhead event capture.
+
+``backend()`` reports which implementation is active.  The shared library is built
+on demand with ``make`` in ``native/`` (no pip deps); every entry point falls back
+to Python when the build is unavailable, and the test suite covers both paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .core.types import GridOrder
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libslate_rt.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _order_code(order) -> int:
+    return 0 if GridOrder.from_string(order) == GridOrder.Col else 1
+
+
+def build() -> bool:
+    """Compile native/libslate_rt.so with make.  Called once at import (unless
+    SLATE_TPU_NATIVE=0) so the compile never lands inside a hot/traced path;
+    callers can also invoke it explicitly after a clean."""
+    global _tried
+    try:
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                              timeout=120)
+        _tried = False            # allow _load to pick up the fresh build
+        return proc.returncode == 0
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.srt_owner_map.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                                  ctypes.c_int32, ctypes.c_int32, i32p]
+    lib.srt_local_tiles.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                                    ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                                    i64p]
+    lib.srt_local_tiles.restype = ctypes.c_int64
+    lib.srt_redist_plan.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                                    ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                                    i32p, i32p]
+    lib.srt_redist_plan.restype = ctypes.c_int64
+    lib.srt_pool_new.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.srt_pool_new.restype = ctypes.c_void_p
+    lib.srt_pool_delete.argtypes = [ctypes.c_void_p]
+    lib.srt_pool_alloc.argtypes = [ctypes.c_void_p]
+    lib.srt_pool_alloc.restype = ctypes.c_int64
+    lib.srt_pool_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.srt_pool_free.restype = ctypes.c_int32
+    for fn in ("srt_pool_in_use", "srt_pool_capacity", "srt_pool_peak"):
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        getattr(lib, fn).restype = ctypes.c_int64
+    lib.srt_trace_enable.argtypes = [ctypes.c_int32]
+    lib.srt_trace_begin.argtypes = [ctypes.c_char_p]
+    lib.srt_trace_end.argtypes = []
+    lib.srt_trace_count.restype = ctypes.c_int64
+    lib.srt_trace_dump.argtypes = [ctypes.c_char_p]
+    lib.srt_trace_dump.restype = ctypes.c_int32
+    _lib = lib
+    return _lib
+
+
+def backend() -> str:
+    """'native' when libslate_rt.so is loaded, else 'python'."""
+    return "native" if _load() is not None else "python"
+
+
+# ---------------------------------------------------------------------------
+# block-cyclic maps
+
+def owner_map(mt: int, nt: int, p: int, q: int,
+              order=GridOrder.Col) -> np.ndarray:
+    """Full (mt, nt) int32 tile->rank map for a 2D block-cyclic grid
+    (func.hh:178-186 applied over the whole tile space)."""
+    code = _order_code(order)
+    lib = _load()
+    out = np.empty((mt, nt), dtype=np.int32)
+    if lib is not None and mt * nt > 0:
+        lib.srt_owner_map(mt, nt, p, q, code,
+                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+    i = np.arange(mt)[:, None] % p
+    j = np.arange(nt)[None, :] % q
+    return (i + j * p if code == 0 else i * q + j).astype(np.int32)
+
+
+def local_tiles(mt: int, nt: int, p: int, q: int, rank: int,
+                order=GridOrder.Col) -> np.ndarray:
+    """(k, 2) array of the (i, j) tile indices owned by ``rank`` (the reference's
+    per-rank tile-directory iteration, MatrixStorage.hh)."""
+    code = _order_code(order)
+    lib = _load()
+    if lib is not None:
+        count = lib.srt_local_tiles(mt, nt, p, q, code, rank, None)
+        out = np.empty((count, 2), dtype=np.int64)
+        if count:
+            lib.srt_local_tiles(mt, nt, p, q, code, rank,
+                                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
+    om = owner_map(mt, nt, p, q, order)
+    ii, jj = np.nonzero(om == rank)
+    return np.stack([ii, jj], axis=1).astype(np.int64)
+
+
+def redist_plan(mt: int, nt: int,
+                src_grid: Tuple[int, int], dst_grid: Tuple[int, int],
+                src_order=GridOrder.Col, dst_order=GridOrder.Col):
+    """Per-tile (src_rank, dst_rank) maps between two block-cyclic layouts and the
+    count of tiles that move (src/redistribute.cc's send/recv planning loop).
+
+    Returns (src_map, dst_map, n_moved)."""
+    c1, c2 = _order_code(src_order), _order_code(dst_order)
+    lib = _load()
+    if lib is not None:
+        src = np.empty((mt, nt), dtype=np.int32)
+        dst = np.empty((mt, nt), dtype=np.int32)
+        moved = lib.srt_redist_plan(
+            mt, nt, src_grid[0], src_grid[1], c1, dst_grid[0], dst_grid[1], c2,
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return src, dst, int(moved)
+    src = owner_map(mt, nt, src_grid[0], src_grid[1], src_order)
+    dst = owner_map(mt, nt, dst_grid[0], dst_grid[1], dst_order)
+    return src, dst, int(np.count_nonzero(src != dst))
+
+
+# ---------------------------------------------------------------------------
+# memory-pool accounting
+
+class MemoryPool:
+    """Fixed-block workspace accounting (src/core/Memory.cc free list).
+
+    XLA owns the actual HBM; this tracks tile-granular workspace budget so
+    drivers can reason about fit/spill (the reference's reserveDeviceWorkspace
+    planning).  alloc() returns a block id or -1 when exhausted; free() returns
+    False on double-free (the Debug.cc leak check).
+    """
+
+    def __init__(self, block_bytes: int, nblocks: int):
+        self.block_bytes = int(block_bytes)
+        self._lib = _load()
+        if self._lib is not None:
+            self._pool = self._lib.srt_pool_new(block_bytes, nblocks)
+            self._free: Optional[List[int]] = None
+        else:
+            self._pool = None
+            self._free = list(range(nblocks - 1, -1, -1))
+            self._used = set()
+            self._peak = 0
+            self._cap = nblocks
+
+    def alloc(self) -> int:
+        if self._pool is not None:
+            return int(self._lib.srt_pool_alloc(self._pool))
+        if not self._free:
+            return -1
+        bid = self._free.pop()
+        self._used.add(bid)
+        self._peak = max(self._peak, len(self._used))
+        return bid
+
+    def free(self, block_id: int) -> bool:
+        if self._pool is not None:
+            return int(self._lib.srt_pool_free(self._pool, block_id)) == 0
+        if block_id not in self._used:
+            return False
+        self._used.discard(block_id)
+        self._free.append(block_id)
+        return True
+
+    @property
+    def in_use(self) -> int:
+        if self._pool is not None:
+            return int(self._lib.srt_pool_in_use(self._pool))
+        return len(self._used)
+
+    @property
+    def capacity(self) -> int:
+        if self._pool is not None:
+            return int(self._lib.srt_pool_capacity(self._pool))
+        return self._cap
+
+    @property
+    def peak(self) -> int:
+        if self._pool is not None:
+            return int(self._lib.srt_pool_peak(self._pool))
+        return self._peak
+
+    def __del__(self):
+        if getattr(self, "_pool", None) is not None and self._lib is not None:
+            self._lib.srt_pool_delete(self._pool)
+            self._pool = None
+
+
+# ---------------------------------------------------------------------------
+# native trace capture
+
+def trace_enable(on: bool = True) -> None:
+    lib = _load()
+    if lib is not None:
+        lib.srt_trace_enable(1 if on else 0)
+
+
+def trace_begin(name: str) -> None:
+    lib = _load()
+    if lib is not None:
+        lib.srt_trace_begin(name.encode())
+
+
+def trace_end() -> None:
+    lib = _load()
+    if lib is not None:
+        lib.srt_trace_end()
+
+
+def trace_count() -> int:
+    lib = _load()
+    return int(lib.srt_trace_count()) if lib is not None else 0
+
+
+def trace_clear() -> None:
+    lib = _load()
+    if lib is not None:
+        lib.srt_trace_clear()
+
+
+def trace_dump(path: str) -> bool:
+    """Write captured events as chrome://tracing JSON (Trace.cc:330-448's SVG
+    writer, modernized). Returns False when native capture is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    return int(lib.srt_trace_dump(path.encode())) == 0
+
+
+# build once at import time (outside any traced/hot path); opt out with
+# SLATE_TPU_NATIVE=0 (pure-Python fallbacks remain fully functional)
+if (os.environ.get("SLATE_TPU_NATIVE", "1") != "0"
+        and not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR)):
+    build()

@@ -30,7 +30,11 @@ _PK_WHICH = {Norm.Max: "max", Norm.One: "one", Norm.Inf: "inf", Norm.Fro: "fro"}
 
 
 def _pallas_ok(A) -> bool:
+    # complex dtypes stay on the XLA path: Mosaic has no complex lowering, so
+    # the kernel's jnp.abs would fail to compile on the real TPU backend
     return (USE_PALLAS and _pk.available() and getattr(A, "ndim", 0) == 2
+            and not jnp.issubdtype(getattr(A, "dtype", jnp.float32),
+                                   jnp.complexfloating)
             and jax.default_backend() == "tpu")
 
 

@@ -38,9 +38,15 @@ _t0 = time.perf_counter()
 
 
 def on() -> None:
-    """Enable tracing (reference trace::Trace::on())."""
+    """Enable tracing (reference trace::Trace::on()).  Also arms the native
+    capture buffer (native/slate_rt.cpp) when the runtime library is built."""
     global _enabled
     _enabled = True
+    try:
+        from .. import native
+        native.trace_enable(True)
+    except Exception:  # pragma: no cover - fallback-only environments
+        pass
 
 
 def off() -> None:
@@ -64,12 +70,19 @@ def trace_block(name: str, **attrs):
         return
     start = time.perf_counter()
     try:
+        from .. import native as _nat
+        _nat.trace_begin(name)
+    except Exception:  # pragma: no cover
+        _nat = None
+    try:
         if _JaxAnnotation is not None:
             with _JaxAnnotation(name):
                 yield
         else:
             yield
     finally:
+        if _nat is not None:
+            _nat.trace_end()
         end = time.perf_counter()
         ev = {
             "name": name, "ph": "X", "cat": "slate",
