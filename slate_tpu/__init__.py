@@ -36,6 +36,8 @@ from . import simplified
 from . import matgen
 from . import native
 from .matgen import generate_matrix
+from . import lapack_api
+from . import scalapack_api
 
 try:
     # distributed layer needs jax.shard_map / NamedSharding; single-device use of

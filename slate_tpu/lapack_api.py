@@ -1,0 +1,335 @@
+"""LAPACK-style compatibility API (≅ lapack_api/, 3.2 kLoC).
+
+The reference exports ``slate_dgesv``-style drop-ins so LAPACK callers can link
+against SLATE unchanged (lapack_api/lapack_gesv.cc etc.), tuned through
+``SLATE_LAPACK_*`` environment variables.  This module is the Python equivalent:
+every routine family the reference's lapack_api covers —
+
+    gemm hemm symm herk syrk her2k syr2k trmm trsm          (BLAS-3)
+    lange lansy lanhe lantr                                  (norms)
+    gesv gesv_mixed getrf getrs getri gecon                  (LU)
+    posv potrf potrs potri pocon                             (Cholesky)
+    gels                                                     (least squares)
+    heev heevd syev syevd gesvd                              (eig / SVD)
+    trcon                                                    (condition)
+
+— is exposed with all four type prefixes (s, d, c, z): ``dgesv(a, b)``,
+``spotrf(uplo, a)``, ``zheev(jobz, uplo, a)``, …  numpy in / numpy out, LAPACK
+calling shapes simplified to value-returning Python (info returned, not raised).
+
+Env tuning (≅ lapack_slate.hh:34-96): ``SLATE_LAPACK_NB`` sets the block size,
+``SLATE_LAPACK_VERBOSE=1`` prints each call.
+
+d/z routines need float64 — enable ``jax.config.update("jax_enable_x64", True)``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import blas as _blas
+from . import linalg as _la
+from .core.matrix import HermitianMatrix, Matrix, SymmetricMatrix, TriangularMatrix
+from .core.types import Norm, Options, Uplo
+
+_TYPES = {"s": np.float32, "d": np.float64, "c": np.complex64, "z": np.complex128}
+
+
+def _opts() -> Options:
+    kw = {}
+    nb = os.environ.get("SLATE_LAPACK_NB")
+    if nb:
+        kw["block_size"] = int(nb)
+    return Options.make(kw)
+
+
+def _verbose(name, *shapes):
+    if os.environ.get("SLATE_LAPACK_VERBOSE"):
+        print(f"slate_lapack: {name} {shapes}", file=sys.stderr)
+
+
+def _as(dtype, *arrays):
+    return [np.asarray(a, dtype=dtype) for a in arrays]
+
+
+def _nb(n: int) -> int:
+    return min(_opts().block_size, max(8, n))
+
+
+# ---------------------------------------------------------------------------
+# per-routine implementations, parameterized on dtype
+
+def _gemm(dt, transa, transb, alpha, a, b, beta, c):
+    a, b, c = _as(dt, a, b, c)
+    A = Matrix.from_array(a, nb=_nb(max(a.shape)))
+    B = Matrix.from_array(b, nb=_nb(max(b.shape)))
+    if transa.lower() in ("t", "c"):
+        A = A.H if transa.lower() == "c" else A.T
+    if transb.lower() in ("t", "c"):
+        B = B.H if transb.lower() == "c" else B.T
+    C = Matrix.from_array(c.copy(), nb=_nb(max(c.shape)))
+    _blas.gemm(alpha, A, B, beta, C, _opts())
+    return np.asarray(C.array)
+
+
+def _hemm(dt, side, uplo, alpha, a, b, beta, c, *, sy=False):
+    a, b, c = _as(dt, a, b, c)
+    M = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        Uplo.from_string(uplo), a, nb=_nb(a.shape[0]))
+    B = Matrix.from_array(b, nb=_nb(max(b.shape)))
+    C = Matrix.from_array(c.copy(), nb=_nb(max(c.shape)))
+    (_blas.symm if sy else _blas.hemm)(side, alpha, M, B, beta, C, _opts())
+    return np.asarray(C.array)
+
+
+def _herk(dt, uplo, trans, alpha, a, beta, c, *, sy=False):
+    a, c = _as(dt, a, c)
+    A = Matrix.from_array(a, nb=_nb(max(a.shape)))
+    if trans.lower() in ("t", "c"):
+        A = A.H if trans.lower() == "c" else A.T
+    C = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        Uplo.from_string(uplo), c.copy(), nb=_nb(c.shape[0]))
+    (_blas.syrk if sy else _blas.herk)(alpha, A, beta, C, _opts())
+    return np.asarray(C.full_array())
+
+
+def _her2k(dt, uplo, trans, alpha, a, b, beta, c, *, sy=False):
+    a, b, c = _as(dt, a, b, c)
+    A = Matrix.from_array(a, nb=_nb(max(a.shape)))
+    B = Matrix.from_array(b, nb=_nb(max(b.shape)))
+    if trans.lower() in ("t", "c"):
+        A, B = (A.H, B.H) if trans.lower() == "c" else (A.T, B.T)
+    C = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        Uplo.from_string(uplo), c.copy(), nb=_nb(c.shape[0]))
+    (_blas.syr2k if sy else _blas.her2k)(alpha, A, B, beta, C, _opts())
+    return np.asarray(C.full_array())
+
+
+def _trmm(dt, side, uplo, transa, diag, alpha, a, b, *, solve=False):
+    a, b = _as(dt, a, b)
+    T = TriangularMatrix.from_array(Uplo.from_string(uplo), a,
+                                    nb=_nb(a.shape[0]), diag=diag)
+    if transa.lower() in ("t", "c"):
+        T = T.H if transa.lower() == "c" else T.T
+    B = Matrix.from_array(b.copy(), nb=_nb(max(b.shape)))
+    (_blas.trsm if solve else _blas.trmm)(side, alpha, T, B, _opts(),
+                                          diag=diag)
+    return np.asarray(B.array)
+
+
+def _lange(dt, norm, a):
+    (a,) = _as(dt, a)
+    return float(_blas.norm(norm, Matrix.from_array(a, nb=_nb(max(a.shape))),
+                            _opts()))
+
+
+def _lanhe(dt, norm, uplo, a, *, sy=False):
+    (a,) = _as(dt, a)
+    M = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        Uplo.from_string(uplo), a, nb=_nb(a.shape[0]))
+    return float(_blas.norm(norm, M, _opts()))
+
+
+def _lantr(dt, norm, uplo, diag, a):
+    (a,) = _as(dt, a)
+    T = TriangularMatrix.from_array(Uplo.from_string(uplo), a,
+                                    nb=_nb(a.shape[0]), diag=diag)
+    return float(_blas.norm(norm, T, _opts(), diag=diag))
+
+
+def _gesv(dt, a, b):
+    a, b = _as(dt, a, b)
+    X, perm, info = _la.gesv(a, b, _opts())
+    return np.asarray(X), _la.perm_to_pivots(perm), int(info)
+
+
+def _gesv_mixed(dt, a, b):
+    a, b = _as(dt, a, b)
+    X, perm, info, iters = _la.gesv_mixed(a, b, _opts())
+    return np.asarray(X), _la.perm_to_pivots(perm), int(info), int(iters)
+
+
+def _getrf(dt, a):
+    """Returns (LU, ipiv, info) with 1-based LAPACK ipiv — the same pivot format
+    _gesv returns and _getrs/_getri/_gecon consume."""
+    (a,) = _as(dt, a)
+    lu_, perm, info = _la.getrf(a, _opts())
+    return np.asarray(lu_), _la.perm_to_pivots(perm), int(info)
+
+
+def _perm(ipiv):
+    return jnp.asarray(_la.pivots_to_perm(ipiv))
+
+
+def _getrs(dt, trans, lu_, ipiv, b):
+    lu_, b = _as(dt, lu_, b)
+    X = _la.getrs(lu_, _perm(ipiv), b, _opts(), trans=trans.lower())
+    return np.asarray(X)
+
+
+def _getri(dt, lu_, ipiv):
+    (lu_,) = _as(dt, lu_)
+    return np.asarray(_la.getri(lu_, _perm(ipiv), _opts()))
+
+
+def _gecon(dt, norm, lu_, ipiv, anorm):
+    (lu_,) = _as(dt, lu_)
+    kind = Norm.Inf if str(norm).lower()[0] == "i" else Norm.One
+    return float(_la.gecondest(jnp.asarray(lu_), _perm(ipiv), anorm,
+                               _opts(), norm_kind=kind))
+
+
+def _posv(dt, uplo, a, b):
+    a, b = _as(dt, a, b)
+    M = HermitianMatrix.from_array(Uplo.from_string(uplo), a.copy(),
+                                   nb=_nb(a.shape[0]))
+    B = Matrix.from_array(b.copy(), nb=_nb(max(b.shape)))
+    X, info = _la.posv(M, B, _opts())
+    return np.asarray(B.array), int(info)
+
+
+def _potrf(dt, uplo, a):
+    (a,) = _as(dt, a)
+    M = HermitianMatrix.from_array(Uplo.from_string(uplo), a.copy(),
+                                   nb=_nb(a.shape[0]))
+    L, info = _la.potrf(M, _opts())
+    return np.asarray(L.array if hasattr(L, "array") else L), int(info)
+
+
+def _potrs(dt, uplo, lf, b):
+    lf, b = _as(dt, lf, b)
+    M = HermitianMatrix.from_array(Uplo.from_string(uplo), lf,
+                                   nb=_nb(lf.shape[0]))
+    B = Matrix.from_array(b.copy(), nb=_nb(max(b.shape)))
+    _la.potrs(M, B, _opts(), uplo=Uplo.from_string(uplo))
+    return np.asarray(B.array)
+
+
+def _potri(dt, uplo, lf):
+    (lf,) = _as(dt, lf)
+    M = HermitianMatrix.from_array(Uplo.from_string(uplo), lf.copy(),
+                                   nb=_nb(lf.shape[0]))
+    out = _la.potri(M, _opts(), uplo=Uplo.from_string(uplo))
+    return np.asarray(out.array if hasattr(out, "array") else out)
+
+
+def _pocon(dt, uplo, lf, anorm):
+    (lf,) = _as(dt, lf)
+    return float(_la.pocondest(jnp.asarray(lf), anorm, _opts(), uplo=uplo))
+
+
+def _trcon(dt, norm, uplo, diag, a):
+    (a,) = _as(dt, a)
+    return float(_la.trcondest(jnp.asarray(a), _opts(), uplo=uplo, diag=diag,
+                               norm_kind=norm))
+
+
+def _gels(dt, trans, a, b):
+    a, b = _as(dt, a, b)
+    A = a.conj().T if trans.lower() in ("t", "c") else a
+    return np.asarray(_la.gels(A.copy(), b.copy(), _opts()))
+
+
+def _heev(dt, jobz, uplo, a, *, sy=False):
+    (a,) = _as(dt, a)
+    M = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        Uplo.from_string(uplo), a, nb=_nb(a.shape[0]))
+    lam, z = _la.heev(M, _opts(), want_vectors=jobz.lower() == "v")
+    return ((np.asarray(lam), np.asarray(z)) if jobz.lower() == "v"
+            else (np.asarray(lam), None))
+
+
+def _complete_basis(u: np.ndarray, full: int) -> np.ndarray:
+    """Extend orthonormal columns u (m x k) to a full m x m orthogonal basis:
+    QR of [u | I] keeps the leading k columns equal to u (up to sign, fixed)."""
+    m, k = u.shape
+    q, r = np.linalg.qr(np.concatenate([u, np.eye(m, dtype=u.dtype)], axis=1))
+    q = q[:, :full]
+    d = np.sign(np.real(np.diagonal(r)[:k]))
+    d[d == 0] = 1
+    q[:, :k] = q[:, :k] * d[None, :]     # undo QR's sign choice so q[:, :k] == u
+    return q
+
+
+def _gesvd(dt, jobu, jobvt, a):
+    (a,) = _as(dt, a)
+    m, n = a.shape
+    k = min(m, n)
+    want_u = jobu.lower() != "n"
+    want_vt = jobvt.lower() != "n"
+    out = _la.svd(a, _opts(), want_u=want_u, want_vt=want_vt)
+    s = np.asarray(out[0])
+    u = np.asarray(out[1]) if want_u and out[1] is not None else None
+    vt = np.asarray(out[2]) if want_vt and len(out) > 2 and out[2] is not None else None
+    if u is not None and jobu.lower() == "a" and u.shape[1] < m:
+        u = _complete_basis(u, m)        # LAPACK job 'a': full m x m U
+    if vt is not None and jobvt.lower() == "a" and vt.shape[0] < n:
+        vt = _complete_basis(vt.conj().T, n).conj().T
+    return s, u, vt
+
+
+# ---------------------------------------------------------------------------
+# generate the typed entry points: sgemm/dgemm/cgemm/zgemm, ...
+
+_FAMILIES = {
+    "gemm": (_gemm, {}),
+    "hemm": (_hemm, {}), "symm": (_hemm, {"sy": True}),
+    "herk": (_herk, {}), "syrk": (_herk, {"sy": True}),
+    "her2k": (_her2k, {}), "syr2k": (_her2k, {"sy": True}),
+    "trmm": (_trmm, {}), "trsm": (_trmm, {"solve": True}),
+    "lange": (_lange, {}), "lanhe": (_lanhe, {}), "lansy": (_lanhe, {"sy": True}),
+    "lantr": (_lantr, {}),
+    "gesv": (_gesv, {}), "gesv_mixed": (_gesv_mixed, {}),
+    "getrf": (_getrf, {}), "getrs": (_getrs, {}), "getri": (_getri, {}),
+    "gecon": (_gecon, {}),
+    "posv": (_posv, {}), "potrf": (_potrf, {}), "potrs": (_potrs, {}),
+    "potri": (_potri, {}), "pocon": (_pocon, {}), "trcon": (_trcon, {}),
+    "gels": (_gels, {}),
+    "heev": (_heev, {}), "heevd": (_heev, {}),
+    "syev": (_heev, {"sy": True}), "syevd": (_heev, {"sy": True}),
+    "gesvd": (_gesvd, {}),
+}
+
+# complex-only / real-only aliasing like LAPACK: cheev/zheev but ssyev/dsyev
+_SKIP = {
+    ("s", "hemm"), ("d", "hemm"), ("s", "herk"), ("d", "herk"),
+    ("s", "her2k"), ("d", "her2k"), ("s", "lanhe"), ("d", "lanhe"),
+    ("s", "heev"), ("d", "heev"), ("s", "heevd"), ("d", "heevd"),
+    ("c", "syev"), ("z", "syev"), ("c", "syevd"), ("z", "syevd"),
+}
+
+__all__ = []
+
+
+def _make(letter, name, impl, fixed):
+    dt = _TYPES[letter]
+
+    def fn(*args, **kw):
+        _verbose(letter + name, *(getattr(a, "shape", a) for a in args))
+        return impl(dt, *args, **dict(fixed, **kw))
+
+    fn.__name__ = letter + name
+    fn.__qualname__ = letter + name
+    fn.__doc__ = (f"slate_{letter}{name} — LAPACK-compatible wrapper over "
+                  f"slate_tpu (lapack_api/lapack_{name.split('_')[0]}.cc).")
+    return fn
+
+
+for _letter in _TYPES:
+    for _name, (_impl, _fixed) in _FAMILIES.items():
+        if (_letter, _name) in _SKIP:
+            continue
+        _f = _make(_letter, _name, _impl, _fixed)
+        globals()[_letter + _name] = _f
+        __all__.append(_letter + _name)
+
+# dsgesv — the classic mixed-precision name (f64 system, f32 factor)
+dsgesv = globals()["dgesv_mixed"]
+zcgesv = globals()["zgesv_mixed"]
+__all__ += ["dsgesv", "zcgesv"]

@@ -4,7 +4,7 @@ from .chol import (posv, posv_mixed, posv_mixed_gmres, potrf, potri, potrs, trtr
                    trtrm)
 from .lu import (gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
                  getrf, getrf_nopiv, getrf_tntpiv, getri, getri_oop, getrs,
-                 getrs_nopiv, perm_to_pivots, rbt_generate)
+                 getrs_nopiv, perm_to_pivots, pivots_to_perm, rbt_generate)
 from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
                  geqrf, tsqr, unmlq, unmqr)
 from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr, sterf,

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.exceptions import SlateError
 from ..core.matrix import as_array
 from ..core.types import Norm, Options, Uplo
 from ..ops import norms as norm_ops
@@ -48,11 +49,18 @@ def norm1est(solve: Callable, solve_h: Callable, n: int, dtype,
     return jnp.maximum(est, alt)
 
 
-def gecondest(LU, perm, anorm, opts=None):
-    """1-norm reciprocal condition estimate from an LU factorization
-    (src/gecondest.cc): rcond = 1 / (||A||_1 * est(||A^{-1}||_1))."""
+def gecondest(LU, perm, anorm, opts=None, norm_kind=Norm.One):
+    """Reciprocal condition estimate from an LU factorization (src/gecondest.cc):
+    rcond = 1 / (||A|| * est(||A^{-1}||)) in the 1- or inf-norm.
+
+    The inf-norm estimate uses ||A^{-1}||_inf == ||A^{-H}||_1 (entries of M^H have
+    the same magnitudes), i.e. the same power iteration with the two solves
+    swapped — pass anorm measured in the matching norm."""
     lu_ = as_array(LU)
     n = lu_.shape[-1]
+    norm_kind = Norm.from_string(norm_kind)
+    if norm_kind not in (Norm.One, Norm.Inf):
+        raise SlateError("gecondest supports One or Inf norms")
 
     def solve(x):
         from .lu import lu_factored_solve
@@ -69,7 +77,10 @@ def gecondest(LU, perm, anorm, opts=None):
             z = jnp.zeros_like(z).at[perm].set(z)
         return z
 
-    inv_norm = norm1est(solve, solve_h, n, lu_.dtype)
+    if norm_kind == Norm.Inf:
+        inv_norm = norm1est(solve_h, solve, n, lu_.dtype)
+    else:
+        inv_norm = norm1est(solve, solve_h, n, lu_.dtype)
     rcond = 1.0 / (jnp.asarray(anorm, inv_norm.dtype) * inv_norm)
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
 

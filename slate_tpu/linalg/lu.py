@@ -67,6 +67,19 @@ def perm_to_pivots(perm):
     return ipiv
 
 
+def pivots_to_perm(ipiv):
+    """Inverse of perm_to_pivots: replay the 1-based sequential row interchanges
+    into the permutation vector our getrs/getri consume."""
+    import numpy as np
+
+    ip = np.asarray(ipiv).tolist()
+    rows = list(range(len(ip)))
+    for k, one_based in enumerate(ip):
+        j = int(one_based) - 1
+        rows[k], rows[j] = rows[j], rows[k]
+    return np.asarray(rows, dtype=np.int64)
+
+
 def _compose_perm(outer, inner):
     """perm = outer ∘ inner: result[i] = inner[outer[i]]."""
     return jnp.take(inner, outer)
@@ -323,16 +336,23 @@ def lu_factored_solve(plu, perm, rhs):
 
 
 def getrs(LU, perm, B, opts=None, trans=False):
-    """Solve A X = B from the LU factor (src/getrs.cc: permuteRows(Forward) +
-    work::trsm(L) + work::trsm(U); here: one gather + two TriangularSolves)."""
+    """Solve op(A) X = B from the LU factor (src/getrs.cc: permuteRows(Forward) +
+    work::trsm(L) + work::trsm(U); here: one gather + two TriangularSolves).
+
+    ``trans``: False/'n' solves A X = B; True/'t' solves A^T X = B; 'c' solves
+    A^H X = B (the LAPACK trans codes)."""
     lu_ = as_array(LU)
     b = as_array(B)
-    if trans:
-        # A^T x = b  =>  U^T y = b; L^T z = y; x = perm^{-1} scatter
+    code = ({False: "n", True: "t"}.get(trans, trans) or "n")
+    code = str(code).lower()[0]
+    if code in ("t", "c"):
+        conj = code == "c"
+        # op(A) x = b  =>  U^op y = b; L^op z = y; x = perm^{-1} scatter
         y = lax.linalg.triangular_solve(lu_, b, left_side=True, lower=False,
-                                        transpose_a=True)
+                                        transpose_a=True, conjugate_a=conj)
         z = lax.linalg.triangular_solve(lu_, y, left_side=True, lower=True,
-                                        unit_diagonal=True, transpose_a=True)
+                                        unit_diagonal=True, transpose_a=True,
+                                        conjugate_a=conj)
         x = jnp.zeros_like(z).at[perm].set(z) if perm is not None else z
         return write_back(B, x)
     return write_back(B, lu_factored_solve(lu_, perm, b))

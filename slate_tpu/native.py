@@ -35,18 +35,42 @@ def _order_code(order) -> int:
     return 0 if GridOrder.from_string(order) == GridOrder.Col else 1
 
 
+_FAIL_STAMP = os.path.join(_NATIVE_DIR, ".build_failed")
+
+
 def build() -> bool:
     """Compile native/libslate_rt.so with make.  Called once at import (unless
     SLATE_TPU_NATIVE=0) so the compile never lands inside a hot/traced path;
-    callers can also invoke it explicitly after a clean."""
+    callers can also invoke it explicitly after a clean.  A failed attempt is
+    stamped so later imports don't re-pay the compile; explicit build() retries."""
     global _tried
     try:
         proc = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
                               timeout=120)
         _tried = False            # allow _load to pick up the fresh build
-        return proc.returncode == 0
+        ok = proc.returncode == 0
     except Exception:
-        return False
+        ok = False
+    try:
+        if ok:
+            if os.path.exists(_FAIL_STAMP):
+                os.unlink(_FAIL_STAMP)
+        else:
+            open(_FAIL_STAMP, "w").close()
+    except OSError:
+        pass
+    return ok
+
+
+def _should_autobuild() -> bool:
+    import shutil
+    return (os.environ.get("SLATE_TPU_NATIVE", "1") != "0"
+            and not os.path.exists(_LIB_PATH)
+            and os.path.isdir(_NATIVE_DIR)
+            and os.access(_NATIVE_DIR, os.W_OK)
+            and not os.path.exists(_FAIL_STAMP)
+            and shutil.which("make") is not None
+            and shutil.which(os.environ.get("CXX", "g++")) is not None)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -267,7 +291,7 @@ def trace_dump(path: str) -> bool:
 
 
 # build once at import time (outside any traced/hot path); opt out with
-# SLATE_TPU_NATIVE=0 (pure-Python fallbacks remain fully functional)
-if (os.environ.get("SLATE_TPU_NATIVE", "1") != "0"
-        and not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR)):
+# SLATE_TPU_NATIVE=0 (pure-Python fallbacks remain fully functional); failed
+# attempts are stamped so imports never re-pay a doomed compile
+if _should_autobuild():
     build()

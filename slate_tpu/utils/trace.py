@@ -52,6 +52,11 @@ def on() -> None:
 def off() -> None:
     global _enabled
     _enabled = False
+    try:
+        from .. import native
+        native.trace_enable(False)    # disarm the C++ capture buffer too
+    except Exception:  # pragma: no cover
+        pass
 
 
 def is_on() -> bool:

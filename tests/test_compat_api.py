@@ -1,0 +1,233 @@
+"""LAPACK-API and ScaLAPACK-API compatibility skins (≅ lapack_api/, scalapack_api/
+drop-in semantics, checked against numpy/scipy)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu import lapack_api as lapi
+from slate_tpu import scalapack_api as slapi
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def spd(n, seed=0, dtype=np.float32):
+    a = rng(seed).standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+class TestBlas3:
+    def test_sgemm(self):
+        a = rng(1).standard_normal((12, 8)).astype(np.float32)
+        b = rng(2).standard_normal((8, 10)).astype(np.float32)
+        c = rng(3).standard_normal((12, 10)).astype(np.float32)
+        out = lapi.sgemm("n", "n", 2.0, a, b, 0.5, c)
+        np.testing.assert_allclose(out, 2.0 * a @ b + 0.5 * c, rtol=1e-4)
+
+    def test_sgemm_trans(self):
+        a = rng(1).standard_normal((8, 12)).astype(np.float32)
+        b = rng(2).standard_normal((10, 8)).astype(np.float32)
+        c = np.zeros((12, 10), np.float32)
+        out = lapi.sgemm("t", "t", 1.0, a, b, 0.0, c)
+        np.testing.assert_allclose(out, a.T @ b.T, rtol=1e-5)
+
+    def test_zgemm_conj(self):
+        r = rng(4)
+        a = (r.standard_normal((6, 5)) + 1j * r.standard_normal((6, 5))).astype(np.complex64)
+        b = (r.standard_normal((6, 7)) + 1j * r.standard_normal((6, 7))).astype(np.complex64)
+        out = lapi.cgemm("c", "n", 1.0, a, b, 0.0, np.zeros((5, 7), np.complex64))
+        np.testing.assert_allclose(out, a.conj().T @ b, rtol=1e-4)
+
+    def test_strsm(self):
+        t = np.tril(rng(5).standard_normal((8, 8))).astype(np.float32) + \
+            8 * np.eye(8, dtype=np.float32)
+        b = rng(6).standard_normal((8, 3)).astype(np.float32)
+        x = lapi.strsm("left", "lower", "n", "n", 1.0, t, b)
+        np.testing.assert_allclose(t @ x, b, rtol=1e-4, atol=1e-4)
+
+    def test_ssyrk(self):
+        a = rng(7).standard_normal((6, 4)).astype(np.float32)
+        c = spd(6, 8)
+        out = lapi.ssyrk("lower", "n", 1.0, a, 1.0, c)
+        np.testing.assert_allclose(out, a @ a.T + c, rtol=1e-4)
+
+    def test_slange(self):
+        a = rng(9).standard_normal((10, 6)).astype(np.float32)
+        assert np.isclose(lapi.slange("fro", a), np.linalg.norm(a), rtol=1e-5)
+        assert np.isclose(lapi.slange("one", a), np.abs(a).sum(0).max(), rtol=1e-5)
+
+
+class TestSolvers:
+    def test_sgesv(self):
+        n = 12
+        a = rng(1).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+        b = rng(2).standard_normal((n, 2)).astype(np.float32)
+        x, ipiv, info = lapi.sgesv(a, b)
+        assert info == 0 and ipiv.shape == (n,) and ipiv.min() >= 1
+        np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+
+    def test_sgetrf_getrs_getri(self):
+        n = 10
+        a = rng(3).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+        lu, perm, info = lapi.sgetrf(a)
+        x = lapi.sgetrs("n", lu, perm, rng(4).standard_normal((n,)).astype(np.float32))
+        inv = lapi.sgetri(lu, perm)
+        np.testing.assert_allclose(a @ inv, np.eye(n), atol=1e-3)
+
+    def test_sposv_potrf_pocon(self):
+        n = 16
+        a = spd(n, 5)
+        b = rng(6).standard_normal((n, 2)).astype(np.float32)
+        x, info = lapi.sposv("lower", a, b)
+        assert info == 0
+        np.testing.assert_allclose(a @ x, b, rtol=1e-2, atol=1e-3)
+        lf, info = lapi.spotrf("lower", a)
+        np.testing.assert_allclose(np.tril(lf) @ np.tril(lf).T, a, rtol=1e-2,
+                                   atol=1e-2)
+        rcond = lapi.spocon("lower", lf, lapi.slange("one", a))
+        assert 0 < rcond < 1
+
+    def test_dsgesv_mixed(self):
+        n = 16
+        a = spd(n, 7, np.float64)
+        b = rng(8).standard_normal((n, 1))
+        x, ipiv, info, iters = lapi.dsgesv(a, b)
+        np.testing.assert_allclose(a @ x, b, rtol=1e-8)
+
+    def test_sgels(self):
+        a = rng(9).standard_normal((20, 6)).astype(np.float32)
+        b = rng(10).standard_normal((20, 2)).astype(np.float32)
+        x = lapi.sgels("n", a, b)
+        expect, *_ = np.linalg.lstsq(a, b, rcond=None)
+        np.testing.assert_allclose(np.asarray(x)[:6], expect, rtol=1e-3, atol=1e-3)
+
+
+class TestEigSvd:
+    def test_ssyev(self):
+        a = spd(14, 1)
+        w, z = lapi.ssyev("v", "lower", a)
+        np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), rtol=1e-3)
+        np.testing.assert_allclose(a @ z, z * w[None, :], rtol=1e-2, atol=1e-2)
+
+    def test_cheev(self):
+        r = rng(2)
+        a = (r.standard_normal((10, 10)) + 1j * r.standard_normal((10, 10))).astype(np.complex64)
+        a = a @ a.conj().T + 10 * np.eye(10)
+        w, _ = lapi.cheev("n", "lower", a.astype(np.complex64))
+        np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), rtol=1e-3)
+
+    def test_sgesvd(self):
+        a = rng(3).standard_normal((12, 8)).astype(np.float32)
+        s, u, vt = lapi.sgesvd("s", "s", a)
+        np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                                   rtol=1e-4)
+        np.testing.assert_allclose((u * s[None, :]) @ vt, a, rtol=1e-3, atol=1e-3)
+
+    def test_real_complex_name_split(self):
+        assert not hasattr(lapi, "sheev")      # LAPACK has ssyev, not sheev
+        assert not hasattr(lapi, "csyev")      # and cheev, not csyev
+        assert hasattr(lapi, "dsyevd") and hasattr(lapi, "zheevd")
+
+
+class TestLapackContracts:
+    def test_pivot_format_consistent(self):
+        """sgetrf and sgesv return the same 1-based ipiv format, interchangeable
+        with sgetrs/sgetri."""
+        n = 8
+        a = rng(11).standard_normal((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+        b = rng(12).standard_normal((n,)).astype(np.float32)
+        x1, ipiv1, _ = lapi.sgesv(a, b.copy())
+        lu, ipiv2, _ = lapi.sgetrf(a)
+        np.testing.assert_array_equal(ipiv1, ipiv2)
+        assert ipiv2.min() >= 1
+        x2 = lapi.sgetrs("n", lu, ipiv2, b.copy())
+        np.testing.assert_allclose(np.asarray(x1), np.asarray(x2), rtol=1e-5)
+
+    def test_zgetrs_conjugate_transpose(self):
+        """trans='c' must solve A^H x = b, not A^T x = b."""
+        n = 6
+        r = rng(13)
+        a = (r.standard_normal((n, n)) + 1j * r.standard_normal((n, n))
+             ).astype(np.complex64) + n * np.eye(n)
+        b = (r.standard_normal(n) + 1j * r.standard_normal(n)).astype(np.complex64)
+        lu, ipiv, _ = lapi.zgetrf(a)
+        x = lapi.zgetrs("c", lu, ipiv, b.copy())
+        np.testing.assert_allclose(a.conj().T @ np.asarray(x), b, rtol=1e-3,
+                                   atol=1e-3)
+        xt = lapi.zgetrs("t", lu, ipiv, b.copy())
+        np.testing.assert_allclose(a.T @ np.asarray(xt), b, rtol=1e-3, atol=1e-3)
+
+    def test_gecon_inf_norm(self):
+        n = 16
+        a = spd(n, 14, np.float64)
+        lu, ipiv, _ = lapi.dgetrf(a)
+        r1 = lapi.dgecon("1", lu, ipiv, lapi.dlange("one", a))
+        ri = lapi.dgecon("i", lu, ipiv, lapi.dlange("inf", a))
+        true1 = 1.0 / np.linalg.cond(a, 1)
+        truei = 1.0 / np.linalg.cond(a, np.inf)
+        assert 0.1 < r1 / true1 < 10
+        assert 0.1 < ri / truei < 10
+
+    def test_gesvd_full_matrices(self):
+        a = rng(15).standard_normal((12, 8)).astype(np.float32)
+        s, u, vt = lapi.sgesvd("a", "a", a)
+        assert u.shape == (12, 12) and vt.shape == (8, 8)
+        np.testing.assert_allclose(u.T @ u, np.eye(12), atol=1e-4)
+        np.testing.assert_allclose(vt @ vt.T, np.eye(8), atol=1e-4)
+        np.testing.assert_allclose((u[:, :8] * s[None, :]) @ vt, a, rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestEnvTuning:
+    def test_nb_env(self, monkeypatch):
+        monkeypatch.setenv("SLATE_LAPACK_NB", "8")
+        a = rng(1).standard_normal((16, 16)).astype(np.float32)
+        b = rng(2).standard_normal((16, 16)).astype(np.float32)
+        out = lapi.sgemm("n", "n", 1.0, a, b, 0.0, np.zeros_like(a))
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+class TestScalapack:
+    def test_without_grid_falls_through(self):
+        slapi.gridexit()
+        a = rng(1).standard_normal((8, 8)).astype(np.float32)
+        out = slapi.psgemm("n", "n", 1.0, a, a, 0.0, np.zeros_like(a))
+        np.testing.assert_allclose(out, a @ a, rtol=1e-5)
+
+    def test_grid_gemm_distributed(self):
+        """pdgemm over a 2x2 grid on the virtual CPU mesh (the mpirun -np 4
+        analogue, SURVEY.md §4)."""
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        grid = slapi.gridinit(2, 2)
+        try:
+            a = rng(2).standard_normal((24, 20)).astype(np.float32)
+            b = rng(3).standard_normal((20, 28)).astype(np.float32)
+            c = rng(4).standard_normal((24, 28)).astype(np.float32)
+            out = slapi.psgemm("n", "n", 1.5, a, b, 0.5, c)
+            np.testing.assert_allclose(out, 1.5 * a @ b + 0.5 * c, rtol=1e-4,
+                                       atol=1e-4)
+        finally:
+            slapi.gridexit()
+
+    def test_grid_posv(self):
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        slapi.gridinit(2, 2)
+        try:
+            n = 16
+            a = spd(n, 5)
+            b = rng(6).standard_normal((n, 2)).astype(np.float32)
+            x, info = slapi.psposv("lower", a, b)
+            np.testing.assert_allclose(a @ x, b, rtol=1e-2, atol=1e-3)
+        finally:
+            slapi.gridexit()
+
+    def test_grid_too_big_raises(self):
+        import jax
+        with pytest.raises(ValueError):
+            slapi.gridinit(len(jax.devices()) + 1, 2)
+        slapi.gridexit()
