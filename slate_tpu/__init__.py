@@ -35,6 +35,7 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, gecondest,
 from . import simplified
 from . import matgen
 from . import native
+from .utils import debug, load_matrix, print_matrix, save_matrix, trace
 from .matgen import generate_matrix
 from . import lapack_api
 from . import scalapack_api

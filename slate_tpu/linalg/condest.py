@@ -124,6 +124,11 @@ def trcondest(T, opts=None, uplo=None, diag=None, norm_kind=Norm.One):
                                            lower=lower, unit_diagonal=unit,
                                            transpose_a=True, conjugate_a=True)[:, 0]
 
-    inv_norm = norm1est(solve, solve_h, n, t.dtype)
+    # inf-norm: ||T^{-1}||_inf == ||T^{-H}||_1 — same estimator, solves swapped
+    # (the fix mirrors gecondest)
+    if Norm.from_string(norm_kind) == Norm.Inf:
+        inv_norm = norm1est(solve_h, solve, n, t.dtype)
+    else:
+        inv_norm = norm1est(solve, solve_h, n, t.dtype)
     rcond = 1.0 / (anorm * inv_norm)
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)

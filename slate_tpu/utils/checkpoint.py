@@ -1,0 +1,88 @@
+"""Save / load of (distributed) matrices.
+
+Reference analogue: none — SLATE has no checkpointing (SURVEY.md §5.4 records the
+gap); the nearest mechanisms are ``redistribute`` (migrate between distributions)
+and ``print``'s gather.  Provided here as the convenience the survey recommends:
+npz-based save/load that round-trips the matrix data *and* its layout metadata
+(type, uplo/diag/band, tile size, grid), so a solver pipeline can be resumed on a
+different mesh — the load path re-distributes via the normal constructors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.matrix import (BandMatrix, BaseMatrix, HermitianBandMatrix,
+                           HermitianMatrix, Matrix, SymmetricMatrix,
+                           TrapezoidMatrix, TriangularBandMatrix,
+                           TriangularMatrix)
+from ..core.types import Uplo
+
+__all__ = ["save_matrix", "load_matrix"]
+
+_TYPES = {c.__name__: c for c in
+          (Matrix, TrapezoidMatrix, TriangularMatrix, SymmetricMatrix,
+           HermitianMatrix, BandMatrix, TriangularBandMatrix,
+           HermitianBandMatrix)}
+
+
+def save_matrix(path: str, A, **extra) -> None:
+    """Write matrix + layout metadata to ``path`` (.npz).  Sharded backing arrays
+    are gathered (np.asarray inserts the collective), like print.cc's gather."""
+    meta: dict = dict(extra)
+    if isinstance(A, BaseMatrix):
+        order, p, q = A.gridinfo()
+        meta.update(type=type(A).__name__, mb=A.storage.mb, nb=A.storage.nb,
+                    p=p, q=q, order=str(order))
+        for attr in ("uplo", "diag"):
+            if hasattr(A, attr):
+                meta[attr] = str(getattr(A, attr))
+        for attr in ("kl", "ku", "kd"):
+            if hasattr(A, attr):
+                meta[attr] = int(getattr(A, attr))
+        data = np.asarray(A.storage.array)
+    else:
+        meta["type"] = "array"
+        data = np.asarray(A)
+    np.savez(path, data=data, **{f"meta_{k}": np.asarray(v)
+                                 for k, v in meta.items()})
+
+
+def load_matrix(path: str, p: Optional[int] = None, q: Optional[int] = None):
+    """Reconstruct the matrix (optionally onto a different p x q grid — the
+    redistribute-on-restore path)."""
+    with np.load(path, allow_pickle=False) as z:
+        data = z["data"]
+        meta = {k[len("meta_"):]: z[k][()] for k in z.files if k.startswith("meta_")}
+    tname = str(meta.get("type", "array"))
+    if tname == "array":
+        return data
+    cls = _TYPES[tname]
+    nb = int(meta["nb"])
+    p = int(meta["p"]) if p is None else p
+    q = int(meta["q"]) if q is None else q
+    kw = {"nb": nb, "p": p, "q": q}
+    import jax.numpy as jnp
+
+    if tname == "Matrix":
+        # Matrix supports rectangular tiles + grid order; restore them exactly
+        from ..core.types import GridOrder
+        return Matrix.from_array(data, mb=int(meta.get("mb", nb)),
+                                 order=GridOrder.from_string(str(meta["order"])),
+                                 **kw)
+    if tname == "BandMatrix":
+        M = BandMatrix(data.shape[0], data.shape[1], int(meta["kl"]),
+                       int(meta["ku"]), **kw)
+        M.set_array(jnp.asarray(data))
+        return M
+    if tname in ("TriangularBandMatrix", "HermitianBandMatrix"):
+        M = cls(Uplo.from_string(str(meta["uplo"])), data.shape[0],
+                int(meta["kd"]), **kw)
+        M.set_array(jnp.asarray(data))
+        return M
+    uplo = Uplo.from_string(str(meta["uplo"]))
+    if "diag" in meta and tname == "TriangularMatrix":
+        kw["diag"] = str(meta["diag"])
+    return cls.from_array(uplo, data, **kw)

@@ -159,15 +159,28 @@ class TestLapackContracts:
         np.testing.assert_allclose(a.T @ np.asarray(xt), b, rtol=1e-3, atol=1e-3)
 
     def test_gecon_inf_norm(self):
-        n = 16
-        a = spd(n, 14, np.float64)
+        """Asymmetric matrix whose 1- and inf-norm conditions differ sharply, so
+        a missing solve-swap in the inf path cannot pass."""
+        n = 40
+        a = np.eye(n)
+        a[1:, 0] = 1000.0       # heavy first column: cond_1 >> different cond_inf
         lu, ipiv, _ = lapi.dgetrf(a)
         r1 = lapi.dgecon("1", lu, ipiv, lapi.dlange("one", a))
         ri = lapi.dgecon("i", lu, ipiv, lapi.dlange("inf", a))
         true1 = 1.0 / np.linalg.cond(a, 1)
         truei = 1.0 / np.linalg.cond(a, np.inf)
-        assert 0.1 < r1 / true1 < 10
-        assert 0.1 < ri / truei < 10
+        assert 0.2 < r1 / true1 < 5
+        assert 0.2 < ri / truei < 5
+        assert not np.isclose(true1, truei)   # the matrix distinguishes the norms
+
+    def test_trcon_inf_norm(self):
+        n = 40
+        t = np.eye(n)
+        t[1:, 0] = 1000.0
+        ri = lapi.dtrcon("i", "lower", "n", t)
+        truei = 1.0 / (np.abs(t).sum(1).max() *
+                       np.abs(np.linalg.inv(t)).sum(1).max())
+        assert 0.2 < ri / truei < 5
 
     def test_gesvd_full_matrices(self):
         a = rng(15).standard_normal((12, 8)).astype(np.float32)

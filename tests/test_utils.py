@@ -1,0 +1,142 @@
+"""print / checkpoint / debug utilities (≅ print.cc verbosity levels, Debug.hh
+invariants; checkpoint is the convenience SURVEY.md §5.4 recommends)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import slate_tpu as slate
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.utils import debug
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+class TestPrint:
+    def _mat(self, m=6, n=5):
+        return slate.Matrix.from_array(
+            rng(1).standard_normal((m, n)).astype(np.float32), nb=2)
+
+    def test_verbose_0_silent(self):
+        buf = io.StringIO()
+        out = slate.print_matrix("A", self._mat(), verbose=0, file=buf)
+        assert out is None and buf.getvalue() == ""
+
+    def test_verbose_1_meta_only(self):
+        buf = io.StringIO()
+        out = slate.print_matrix("A", self._mat(), verbose=1, file=buf)
+        assert "Matrix 6x5" in out and "grid 1x1" in out
+        assert "[" not in out
+
+    def test_verbose_2_abbreviated(self):
+        big = slate.Matrix.from_array(np.ones((40, 40), np.float32), nb=8)
+        out = slate.print_matrix("B", big, verbose=2, file=io.StringIO())
+        assert "..." in out
+
+    def test_verbose_3_full(self):
+        M = self._mat(3, 3)
+        out = slate.print_matrix("C", M, verbose=3, file=io.StringIO())
+        a = np.asarray(M.array)
+        assert f"{a[0,0]:10.4f}".strip() in out
+
+    def test_verbose_4_tile_rules(self):
+        out = slate.print_matrix("D", self._mat(4, 4), verbose=4,
+                                 file=io.StringIO())
+        assert "|" in out and "-" in out
+
+    def test_plain_array(self):
+        out = slate.print_matrix("E", np.eye(3, dtype=np.float32), verbose=3,
+                                 file=io.StringIO())
+        assert "array 3x3" in out
+
+
+class TestCheckpoint:
+    def test_general_round_trip(self, tmp_path):
+        a = rng(2).standard_normal((12, 10)).astype(np.float32)
+        A = slate.Matrix.from_array(a, nb=4)
+        p = str(tmp_path / "m.npz")
+        slate.save_matrix(p, A)
+        B = slate.load_matrix(p)
+        assert isinstance(B, slate.Matrix)
+        assert B.storage.nb == 4
+        np.testing.assert_array_equal(np.asarray(B.array), a)
+
+    def test_hermitian_round_trip(self, tmp_path):
+        a = rng(3).standard_normal((8, 8)).astype(np.float32)
+        A = slate.HermitianMatrix.from_array(slate.Uplo.Upper, a, nb=4)
+        p = str(tmp_path / "h.npz")
+        slate.save_matrix(p, A)
+        B = slate.load_matrix(p)
+        assert isinstance(B, slate.HermitianMatrix)
+        assert B.uplo == slate.Uplo.Upper
+
+    def test_regrid_on_load(self, tmp_path):
+        a = rng(4).standard_normal((16, 16)).astype(np.float32)
+        A = slate.Matrix.from_array(a, nb=4, p=1, q=1)
+        p = str(tmp_path / "g.npz")
+        slate.save_matrix(p, A)
+        B = slate.load_matrix(p, p=2, q=2)
+        _, gp, gq = B.gridinfo()
+        assert (gp, gq) == (2, 2)
+        np.testing.assert_array_equal(np.asarray(B.array), a)
+
+    def test_plain_array_round_trip(self, tmp_path):
+        a = rng(5).standard_normal((5, 3))
+        p = str(tmp_path / "a.npz")
+        slate.save_matrix(p, a)
+        np.testing.assert_array_equal(slate.load_matrix(p), a)
+
+    def test_band_round_trip(self, tmp_path):
+        from slate_tpu.core.matrix import HermitianBandMatrix
+        n, kd = 10, 2
+        a = rng(6).standard_normal((n, n)).astype(np.float32)
+        band = np.triu(np.tril(a + a.T, kd), -kd)
+        M = HermitianBandMatrix(slate.Uplo.Lower, n, kd, nb=4)
+        import jax.numpy as jnp
+        M.set_array(jnp.asarray(np.tril(band).astype(np.float32)))
+        p = str(tmp_path / "b.npz")
+        slate.save_matrix(p, M)
+        B = slate.load_matrix(p)
+        assert isinstance(B, HermitianBandMatrix) and B.kd == kd
+        np.testing.assert_array_equal(np.asarray(B.array), np.asarray(M.array))
+
+
+class TestDebug:
+    def test_check_finite(self):
+        A = slate.Matrix.from_array(np.ones((4, 4), np.float32), nb=2)
+        assert debug.check_finite(A)
+        bad = np.ones((4, 4), np.float32)
+        bad[2, 1] = np.nan
+        with pytest.raises(SlateError, match="non-finite"):
+            debug.check_finite(slate.Matrix.from_array(bad, nb=2))
+
+    def test_check_owner_map(self):
+        A = slate.Matrix(32, 32, nb=8, p=2, q=2)
+        assert debug.check_owner_map(A)
+
+    def test_check_structure_hermitian(self):
+        a = rng(7).standard_normal((6, 6)).astype(np.complex64)
+        a = a + a.conj().T
+        A = slate.HermitianMatrix.from_array(slate.Uplo.Lower, a, nb=2)
+        assert debug.check_structure(A)
+        a2 = a + 1j * np.eye(6, dtype=np.complex64)
+        with pytest.raises(SlateError, match="imaginary"):
+            debug.check_structure(
+                slate.HermitianMatrix.from_array(slate.Uplo.Lower, a2, nb=2))
+
+    def test_check_no_leaks(self):
+        from slate_tpu import native
+        pool = native.MemoryPool(64, 2)
+        bid = pool.alloc()
+        with pytest.raises(SlateError, match="still allocated"):
+            debug.check_no_leaks(pool)
+        pool.free(bid)
+        assert debug.check_no_leaks(pool)
+
+    def test_tile_summary(self):
+        A = slate.Matrix(32, 32, nb=8, p=2, q=2)
+        s = debug.tile_summary(A)
+        assert "rank 0: 4 tiles" in s and "grid 2x2" in s
