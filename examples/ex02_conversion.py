@@ -1,0 +1,28 @@
+"""ex02: converting between matrix types — general <-> hermitian/triangular views
+(≅ examples/ex02_conversion.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    a = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+    A = slate.Matrix.from_array(a, nb=4)
+
+    # view the lower triangle as Hermitian / the upper as triangular, no copy
+    H = slate.HermitianMatrix.from_array(slate.Uplo.Lower, np.asarray(A.array), nb=4)
+    full = np.asarray(H.full_array())
+    np.testing.assert_allclose(full, np.tril(a) + np.tril(a, -1).T)
+
+    T = slate.TriangularMatrix.from_array(slate.Uplo.Upper, a, nb=4)
+    np.testing.assert_allclose(np.asarray(T.masked_array()), np.triu(a))
+
+    # transpose is a flag flip (Tile.hh:40-52) — same storage
+    At = A.T
+    assert At.m == A.n and float(At.tile(0, 0)[1, 0]) == a[0, 1]
+    print("ex02 OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""ex03: sub-matrices and slices — cheap views sharing storage
+(≅ examples/ex03_submatrix.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    a = np.arange(64, dtype=np.float32).reshape(8, 8)
+    A = slate.Matrix.from_array(a, nb=2)
+
+    # tile-aligned sub-matrix: tiles [1..2] x [0..1]  (BaseMatrix.hh:104-106)
+    S = A.sub(1, 2, 0, 1)
+    np.testing.assert_array_equal(np.asarray(S.array), a[2:6, 0:4])
+
+    # element slice at arbitrary offsets (BaseMatrix.hh:110-121)
+    L = A.slice(3, 6, 1, 4)
+    np.testing.assert_array_equal(np.asarray(L.array), a[3:7, 1:5])
+
+    # writes through a view land in the shared storage
+    S.set_array(np.zeros((4, 4), np.float32))
+    assert not np.asarray(A.array)[2:6, 0:4].any()
+    print("ex03 OK")
+
+
+if __name__ == "__main__":
+    main()

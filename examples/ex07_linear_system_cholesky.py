@@ -1,0 +1,31 @@
+"""ex07: SPD linear systems — posv / potrf / potrs / potri, mixed precision
+(≅ examples/ex07_linear_system_cholesky.cc, a BASELINE config)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    n = 256
+    A0, _ = slate.generate_matrix("spd_geo", n, cond=100.0, seed=4)
+    a = np.asarray(A0)
+    b = np.random.default_rng(5).standard_normal((n, 4)).astype(np.float32)
+
+    M = slate.HermitianMatrix.from_array(slate.Uplo.Lower, a.copy(), nb=64)
+    B = slate.Matrix.from_array(b.copy(), nb=64)
+    X, info = slate.posv(M, B)
+    assert int(info) == 0
+    print("posv resid:", np.linalg.norm(a @ np.asarray(B.array) - b))
+
+    # factor / solve split + inverse + condition estimate
+    L, info = slate.potrf(slate.HermitianMatrix.from_array(slate.Uplo.Lower,
+                                                           a.copy(), nb=64))
+    rcond = float(slate.pocondest(np.asarray(L.array), slate.norm("one", M)))
+    print("pocondest rcond:", rcond)
+    assert 0 < rcond < 1
+    print("ex07 OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""ex08: Hermitian-indefinite systems — hesv/hetrf/hetrs Aasen factorization
+(≅ examples/ex08_linear_system_indefinite.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    n = 96
+    A0, S = slate.generate_matrix("heev_geo", n, cond=50.0, seed=6)  # mixed signs
+    a = np.asarray(A0)
+    assert (np.asarray(S) < 0).any()     # genuinely indefinite
+    b = np.random.default_rng(7).standard_normal((n, 2)).astype(np.float32)
+
+    out = slate.hesv(a.copy(), b.copy(), None)
+    x = np.asarray(out[0])
+    print("hesv resid:", np.linalg.norm(a @ x - b))
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-3
+
+    # factor once / solve many (hetrf + hetrs)
+    fac, info = slate.hetrf(a.copy())
+    x2 = slate.hetrs(fac, b.copy())
+    np.testing.assert_allclose(np.asarray(x2), x, rtol=1e-3, atol=1e-4)
+    print("ex08 OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""ex09: least squares — gels QR/CholQR, over- and under-determined
+(≅ examples/ex09_least_squares.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    r = np.random.default_rng(8)
+    a = r.standard_normal((200, 40)).astype(np.float32)
+    b = r.standard_normal((200, 2)).astype(np.float32)
+
+    x = slate.gels(a.copy(), b.copy())
+    expect, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(np.asarray(x)[:40], expect, rtol=1e-2, atol=1e-3)
+
+    x_qr = slate.gels_qr(a.copy(), b.copy())
+    x_cq = slate.gels_cholqr(a.copy(), b.copy())
+    np.testing.assert_allclose(np.asarray(x_qr)[:40], np.asarray(x_cq)[:40],
+                               rtol=1e-2, atol=1e-3)
+
+    # underdetermined: minimum-norm solution via LQ
+    au = r.standard_normal((30, 80)).astype(np.float32)
+    bu = r.standard_normal((30,)).astype(np.float32)
+    xu = np.asarray(slate.gels(au.copy(), bu.copy()))
+    assert np.linalg.norm(au @ xu - bu) / np.linalg.norm(bu) < 1e-3
+    print("ex09 OK")
+
+
+if __name__ == "__main__":
+    main()

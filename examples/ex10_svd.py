@@ -1,0 +1,32 @@
+"""ex10: singular value decomposition — values only and full factors, two-stage
+scaffolding (≅ examples/ex10_svd.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    n, cond = 96, 1e3
+    A0, S = slate.generate_matrix("svd_logrand", n, cond=cond, seed=9)
+    a = np.asarray(A0)
+
+    vals = np.sort(np.asarray(slate.svd_vals(a)))[::-1]
+    np.testing.assert_allclose(vals, np.sort(np.asarray(S))[::-1], rtol=1e-3)
+
+    s, u, vt = slate.svd(a)
+    recon = (np.asarray(u) * np.asarray(s)[None, :]) @ np.asarray(vt)
+    print("svd recon err:", np.linalg.norm(recon - a) / np.linalg.norm(a))
+    assert np.linalg.norm(recon - a) / np.linalg.norm(a) < 1e-4
+
+    # the explicit two-stage pipeline (ge2tb -> tb2bd -> bdsqr)
+    d, e, U1, VT1 = slate.ge2tb(a[:32, :24])
+    sv2 = np.asarray(slate.bdsqr(d, e))
+    np.testing.assert_allclose(np.sort(sv2)[::-1],
+                               np.linalg.svd(a[:32, :24], compute_uv=False),
+                               rtol=1e-3)
+    print("ex10 OK")
+
+
+if __name__ == "__main__":
+    main()

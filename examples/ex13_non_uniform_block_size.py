@@ -1,0 +1,35 @@
+"""ex13: non-uniform tiles — rectangular mb x nb tiles, ragged edges, custom
+rank maps (≅ examples/ex13_non_uniform_block_size.cc — the reference's lambda
+distributions, func.hh)."""
+
+import numpy as np
+
+import slate_tpu as slate
+from slate_tpu.core import func
+
+
+def main():
+    # rectangular tiles + ragged last tiles
+    a = np.arange(7 * 10, dtype=np.float32).reshape(7, 10)
+    A = slate.Matrix.from_array(a, nb=4, mb=3)
+    assert (A.mt, A.nt) == (3, 3)
+    assert A.tileMb(2) == 1 and A.tileNb(2) == 2     # ragged edges
+    np.testing.assert_array_equal(np.asarray(A.tile(2, 2)), a[6:, 8:])
+
+    # custom distribution lambda (1D row-cyclic) — first-class like func.hh
+    from slate_tpu.core.matrix import Matrix, MatrixStorage
+    import jax.numpy as jnp
+    st = MatrixStorage(jnp.asarray(a), 3, 4, p=2, q=1,
+                       tile_rank=func.process_1d_grid("col", 2))
+    M = Matrix(_storage=st)
+    om = M.owner_map()
+    np.testing.assert_array_equal(om[:, 0], [0, 1, 0])   # i % 2 down rows
+
+    # block-size helpers
+    mb = func.uniform_blocksize(7, 3)
+    assert [mb(i) for i in range(3)] == [3, 3, 1]
+    print("ex13 OK")
+
+
+if __name__ == "__main__":
+    main()

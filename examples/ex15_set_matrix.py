@@ -1,0 +1,34 @@
+"""ex15: setting matrix entries — set/scale/add elementwise drivers and matgen
+kinds (≅ examples/ex15_set_matrix.cc)."""
+
+import numpy as np
+
+import slate_tpu as slate
+
+
+def main():
+    A = slate.Matrix.from_array(np.zeros((6, 6), np.float32), nb=2)
+
+    # set(offdiag, diag) — geset
+    slate.set(1.0, 5.0, A)
+    a = np.asarray(A.array)
+    assert (np.diag(a) == 5).all() and a[0, 1] == 1
+
+    # scale by numer/denom (overflow-safe two-scalar form)
+    slate.scale(3.0, 2.0, A)
+    assert np.diag(np.asarray(A.array))[0] == 7.5
+
+    # add: B = alpha A + beta B
+    B = slate.Matrix.from_array(np.ones((6, 6), np.float32), nb=2)
+    slate.add(2.0, A, 1.0, B)
+    assert np.asarray(B.array)[0, 0] == 2 * 1.5 + 1
+
+    # named generator kinds (matgen)
+    hilb, _ = slate.generate_matrix("hilb", 4)
+    np.testing.assert_allclose(np.asarray(hilb)[0],
+                               [1, 1 / 2, 1 / 3, 1 / 4], rtol=1e-5)
+    print("ex15 OK")
+
+
+if __name__ == "__main__":
+    main()
