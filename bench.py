@@ -1,69 +1,406 @@
-"""Headline benchmark: gemm GFLOP/s on one chip (BASELINE.json config #1,
-"dgemm n=4096 nb=256, 1x1 grid" — examples/ex05_blas.cc / test_gemm in the reference).
+"""TPU benchmark driver covering the five BASELINE.md north-star configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line (the headline gemm metric), with every config's
+GFLOP/s + vs_baseline nested under ``"configs"``; full detail (timings, attempts,
+failures) is written to ``BENCH_DETAIL.json`` next to this file.
 
-Precision envelope: the reference's headline is double precision on GPU; TPU has no
-f64 ALUs, so the comparable configuration is f32 accumulation with
-``Precision.HIGHEST`` (6-pass bf16 emulation — the dtype the z/d routine family maps
-to on TPU, SURVEY.md §7 hard-part 6).  ``vs_baseline`` divides by 15,000 GFLOP/s — a
-measured cuBLAS A100 dgemm figure at n=4096, the reference's native configuration —
-so >1.0 beats the reference hardware's double-precision rate.
+Architecture (hardened after round 1, where a single in-process backend-init
+failure produced no number at all):
+
+- the parent process never imports jax.  Each measurement runs in a fresh child
+  subprocess (``python bench.py --child <config>``), so a wedged TPU-tunnel
+  backend init cannot poison later attempts (jax caches backend-init failures
+  per process).
+- the parent first runs a cheap ``--child probe`` (device enumeration + one tiny
+  matmul) with bounded retries; if the TPU backend never comes up it falls back
+  to CPU (smaller sizes) so the output line is parseable either way, with
+  ``"backend"`` recording which hardware produced it.
+- every child prints its result as the last stdout line in JSON; the parent
+  enforces per-config timeouts and a global deadline.
+
+Precision envelope: the reference's headline is double precision on GPU; TPU has
+no f64 ALUs, so the comparable configuration is f32 with
+``lax.Precision.HIGHEST`` (bf16-emulated full-precision accumulation — the dtype
+the d/z routine family maps to on TPU, SURVEY.md §7 hard-part 6).
+``vs_baseline`` divides by measured/estimated cuBLAS/cuSOLVER A100 fp64 rates
+for the reference's native configuration (see BASELINES below), so >1.0 beats
+the reference hardware's double-precision rate at the same job.
+
+Flop models follow the LAPACK conventions the reference's tester uses
+(blas/lapack flops.hh, cited in BASELINE.md): gemm 2n^3; potrf n^3/3;
+getrf 2n^3/3; tall-skinny least squares 2n^2(m - n/3); heev values 4n^3/3;
+svd values 8n^3/3.  Where our algorithm does *more* arithmetic than the model
+(CholeskyQR2 vs Householder QR) the model still counts the *job*, so the rate
+is an honest effective rate for the same problem.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-from jax import lax
+REPO = os.path.dirname(os.path.abspath(__file__))
+DETAIL_PATH = os.path.join(REPO, "BENCH_DETAIL.json")
 
-BASELINE_GFLOPS = 15_000.0  # cuBLAS dgemm n=4096 on A100 (reference-native config)
+# A100 80GB fp64 rates for the reference-native configuration (cuBLAS/cuSOLVER;
+# gemm figure measured, factorization/eig figures are published-order estimates —
+# documented so vs_baseline is interpretable, not a black box).
+BASELINES = {
+    "gemm": 15000.0,   # cuBLAS dgemm n=4096
+    "potrf": 13000.0,  # cuSOLVER/MAGMA dpotrf n=16384 (gemm-rich, near dgemm rate)
+    "getrf": 9000.0,   # dgetrf n=16384 (pivoting + panel overhead)
+    "gels": 9000.0,    # tall dgels 131072x4096, cholqr path
+    "heev": 150.0,     # dsyevd values n=4096 on 4n^3/3 model
+    "svd": 100.0,      # dgesvd values n=4096 on 8n^3/3 model
+}
+
+CONFIGS = ["gemm", "potrf", "getrf", "gels", "heev", "svd"]
+HEADLINE = "gemm"
+
+# ---------------------------------------------------------------------------
+# children — each runs in its own process, imports jax lazily
+# ---------------------------------------------------------------------------
 
 
-def _time_chain(a, b, k: int, precision, repeats: int = 3) -> float:
-    """Best wall time of one jitted call running k chained matmuls."""
-    scale = 1.0 / jnp.sqrt(jnp.asarray(a.shape[-1], a.dtype))
-
-    def body(i, c):
-        return jnp.matmul(c, b, precision=precision) * scale
-
-    fn = jax.jit(lambda a: lax.fori_loop(0, k, body, a))
-    fn(a).block_until_ready()  # compile + warm up
-    times = []
-    for i in range(repeats):
-        t0 = time.perf_counter()
-        fn(a + jnp.asarray(i, a.dtype)).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    return min(times)
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
 
 
-def bench_gemm(n: int = 4096, dtype=jnp.float32, precision=lax.Precision.HIGHEST,
-               k_small: int = 8, k_large: int = 136):
-    """Compute-only GFLOP/s via a chain-length delta: timing (k_large - k_small)
-    extra matmuls inside one jit call cancels dispatch/transfer overhead (the
-    tunnel round-trip here is ~70 ms — larger than a single n=4096 matmul)."""
+def child_probe():
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    s = float(jnp.sum(x @ x))
+    _emit({"ok": True, "platform": devs[0].platform,
+           "device_kind": devs[0].device_kind, "n_devices": len(devs), "sum": s})
+
+
+def _chain_rate(make_body, a0, k_small, k_large, flops_per_iter, repeats=3):
+    """GFLOP/s via chain-length delta: timing (k_large - k_small) extra
+    iterations of a data-dependent loop inside one jit call cancels dispatch and
+    transfer overhead (the TPU tunnel round-trip is ~70 ms — larger than many
+    single calls at these sizes)."""
+    import jax
+    from jax import lax
+
+    def timed(k):
+        fn = jax.jit(lambda a: lax.fori_loop(0, k, make_body(), a))
+        fn(a0).block_until_ready()  # compile + warm
+        ts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn(a0).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t_small = timed(k_small)
+    t_large = timed(k_large)
+    per_iter = (t_large - t_small) / (k_large - k_small)
+    return flops_per_iter / per_iter / 1e9, per_iter
+
+
+def child_gemm(cpu_fallback):
+    """dgemm n=4096 (BASELINE config #1; reference examples/ex05_blas.cc).
+
+    Times the framework's gemm driver (slate_tpu.blas.gemm, traced under jit —
+    it lowers to one fused XLA matmul at Precision.HIGHEST)."""
+    import jax
+    import jax.numpy as jnp
+    import slate_tpu
+
+    n = 2048 if cpu_fallback else 4096
     key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (n, n), dtype=dtype)
-    b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), dtype=dtype)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), dtype=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(n, jnp.float32))
 
-    t_small = _time_chain(a, b, k_small, precision)
-    t_large = _time_chain(a, b, k_large, precision)
-    per_matmul = (t_large - t_small) / (k_large - k_small)
-    return 2.0 * n**3 / per_matmul / 1e9
+    def make_body():
+        def body(i, c):
+            return slate_tpu.gemm(scale, c, b, 0.0, c,
+                                  opts={"precision": "highest"})
+        return body
+
+    ks, kl = (2, 10) if cpu_fallback else (8, 136)
+    gflops, per_iter = _chain_rate(make_body, a, ks, kl, 2.0 * n**3)
+    _emit({"metric": f"gemm_f32hi_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+
+
+def child_potrf(cpu_fallback):
+    """dpotrf n=16384 (BASELINE config #2; reference ex07 / test_posv).
+
+    Times the framework's potrf XLA target (linalg/chol.py: tril(cholesky(A))).
+    The loop body perturbs the diagonal with a value data-dependent on the
+    previous factor so XLA cannot collapse the chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 4096 if cpu_fallback else 16384
+    key = jax.random.PRNGKey(0)
+    m = jax.random.normal(key, (n, n), dtype=jnp.float32) / jnp.sqrt(
+        jnp.asarray(n, jnp.float32))
+    a = jnp.matmul(m, m.T, precision=lax.Precision.HIGHEST) + 2.0 * jnp.eye(
+        n, dtype=jnp.float32)
+
+    import slate_tpu
+
+    def make_body():
+        def body(i, c):
+            ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
+            return slate_tpu.potrf(ap)[0]
+        return body
+
+    ks, kl = (1, 3) if cpu_fallback else (2, 10)
+    gflops, per_iter = _chain_rate(make_body, a, ks, kl, n**3 / 3.0)
+    _emit({"metric": f"potrf_f32_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+
+
+def child_getrf(cpu_fallback):
+    """dgetrf (BASELINE config #3; reference test_gesv). Partial-pivot LU via the
+    framework's getrf XLA target (linalg/lu.py: lax.linalg.lu)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 4096 if cpu_fallback else 16384
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32)
+
+    import slate_tpu
+
+    def make_body():
+        def body(i, c):
+            ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
+            return slate_tpu.getrf(ap)[0]
+        return body
+
+    ks, kl = (1, 3) if cpu_fallback else (2, 10)
+    gflops, per_iter = _chain_rate(make_body, a, ks, kl, 2.0 * n**3 / 3.0)
+    _emit({"metric": f"getrf_f32_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+
+
+def child_gels(cpu_fallback):
+    """Tall-skinny least squares m=131072 n=4096, CholQR path (BASELINE config
+    #4; reference test_gels). Times the framework's jittable cholqr2 + solve
+    (linalg/qr.py). Rate uses the Householder QR job model 2n^2(m - n/3) so it
+    is comparable with the reference's dgeqrf/dgels rate for the same problem."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    m, n = (16384, 512) if cpu_fallback else (131072, 4096)
+    nrhs = 16
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, n), dtype=jnp.float32)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (m, nrhs), dtype=jnp.float32)
+
+    import slate_tpu
+
+    def cholqr_solve(a, b):
+        # the framework's CholeskyQR2 least-squares path (linalg/qr.py
+        # gels_cholqr — fully jittable since the lax.cond restructure)
+        return slate_tpu.gels_cholqr(a, b)
+
+    fn = jax.jit(cholqr_solve)
+    fn(a, b).block_until_ready()
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fn(a, b).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    sec = min(ts)
+    flops = 2.0 * n * n * (m - n / 3.0) + 4.0 * m * n * nrhs
+    gflops = flops / sec / 1e9
+    _emit({"metric": f"gels_cholqr_f32_{m}x{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "m": m, "n": n, "sec_per_call": sec})
+
+
+def child_heev(cpu_fallback):
+    """Hermitian eigenvalues (BASELINE config #5a; reference test_heev). Times
+    the framework's heev values driver (linalg/eig.py default = fused XLA
+    eigh). Model: 4n^3/3 (tridiagonal reduction dominates)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024 if cpu_fallback else 4096
+    key = jax.random.PRNGKey(0)
+    m = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    a = (m + m.T) / 2.0
+
+    import slate_tpu
+
+    fn = jax.jit(lambda a: slate_tpu.heev(a, uplo="lower", want_vectors=False)[0])
+    fn(a).block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn(a).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    sec = min(ts)
+    gflops = (4.0 * n**3 / 3.0) / sec / 1e9
+    _emit({"metric": f"heev_vals_f32_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
+
+
+def child_svd(cpu_fallback):
+    """Singular values (BASELINE config #5b; reference test_svd). Times the
+    framework's svd_vals path (linalg/svd.py). Model: 8n^3/3."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024 if cpu_fallback else 4096
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32)
+
+    import slate_tpu
+
+    fn = jax.jit(lambda a: slate_tpu.svd_vals(a))
+    fn(a).block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn(a).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    sec = min(ts)
+    gflops = (8.0 * n**3 / 3.0) / sec / 1e9
+    _emit({"metric": f"svd_vals_f32_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
+
+
+CHILDREN = {
+    "probe": lambda cpu: child_probe(),
+    "gemm": child_gemm,
+    "potrf": child_potrf,
+    "getrf": child_getrf,
+    "gels": child_gels,
+    "heev": child_heev,
+    "svd": child_svd,
+}
+
+
+# ---------------------------------------------------------------------------
+# parent — orchestration, retries, fallback; never imports jax
+# ---------------------------------------------------------------------------
+
+
+def _run_child(name, cpu_fallback, timeout):
+    env = dict(os.environ)
+    if cpu_fallback:
+        # JAX_PLATFORMS=cpu alone is NOT enough: the ambient sitecustomize hook
+        # registers the real-TPU 'axon' PJRT plugin and hangs on a wedged
+        # tunnel.  PALLAS_AXON_POOL_IPS="" skips the plugin registration
+        # entirely (same defense as tests/conftest.py's factory pop).
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["BENCH_CPU_FALLBACK"] = "1"
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--child", name],
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "timeout", "elapsed": time.time() - t0}
+    elapsed = time.time() - t0
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    if p.returncode == 0 and lines:
+        try:
+            out = json.loads(lines[-1])
+            out.update({"ok": True, "elapsed": elapsed})
+            return out
+        except json.JSONDecodeError:
+            pass
+    return {"ok": False, "error": f"rc={p.returncode}",
+            "stderr_tail": p.stderr[-2000:], "elapsed": elapsed}
 
 
 def main():
-    gflops = bench_gemm()
+    t_start = time.time()
+    deadline = t_start + float(os.environ.get("BENCH_DEADLINE_SEC", 2700))
+    detail = {"attempts": [], "configs": {}, "backend": None}
+
+    # 1) probe the TPU backend with bounded retries (fresh process each try)
+    probe = None
+    for attempt in range(3):
+        probe = _run_child("probe", cpu_fallback=False, timeout=420)
+        detail["attempts"].append({"config": "probe", "attempt": attempt, **probe})
+        if probe.get("ok"):
+            break
+        time.sleep(15)
+    # an accelerator probe that lands on the CPU backend is NOT a live TPU —
+    # running TPU-sized configs there would just burn the timeouts
+    tpu_up = bool(probe and probe.get("ok")
+                  and probe.get("platform") not in (None, "cpu"))
+    detail["backend"] = probe.get("platform", "unknown") if tpu_up else "cpu-fallback"
+
+    # 2) run each config; on TPU allow one retry for transient tunnel errors,
+    #    then fall back to CPU so a number exists either way
+    for name in CONFIGS:
+        budget = deadline - time.time()
+        if budget < 60:
+            detail["configs"][name] = {"ok": False, "error": "global deadline"}
+            continue
+        res = None
+        if tpu_up:
+            for attempt in range(2):
+                res = _run_child(name, cpu_fallback=False,
+                                 timeout=min(900, max(120, budget)))
+                detail["attempts"].append({"config": name, "attempt": attempt, **res})
+                if res.get("ok"):
+                    break
+                time.sleep(10)
+        if not (res and res.get("ok")):
+            res = _run_child(name, cpu_fallback=True,
+                             timeout=min(900, max(120, deadline - time.time())))
+            res["backend"] = "cpu-fallback"
+            detail["attempts"].append({"config": name, "attempt": "cpu", **res})
+        else:
+            res["backend"] = detail["backend"]
+        if res.get("ok") and isinstance(res.get("value"), (int, float)):
+            res["vs_baseline"] = round(res["value"] / BASELINES[name], 3)
+        detail["configs"][name] = res
+
+    try:
+        with open(DETAIL_PATH, "w") as f:
+            json.dump(detail, f, indent=1, default=str)
+    except OSError:
+        pass
+
+    # 3) the ONE json line: headline gemm + nested per-config summary
+    head = detail["configs"].get(HEADLINE, {})
+    summary = {}
+    for name, res in detail["configs"].items():
+        if res.get("ok"):
+            summary[name] = {"metric": res.get("metric"), "value": res.get("value"),
+                             "vs_baseline": res.get("vs_baseline"),
+                             "backend": res.get("backend")}
+        else:
+            summary[name] = {"error": res.get("error")}
     print(json.dumps({
-        "metric": "gemm_f32hi_n4096_gflops",
-        "value": round(gflops, 1),
+        "metric": head.get("metric", "gemm_f32hi_n4096_gflops"),
+        "value": head.get("value"),
         "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / BASELINE_GFLOPS, 3),
+        "vs_baseline": head.get("vs_baseline"),
+        "backend": head.get("backend", detail["backend"]),
+        "configs": summary,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", default=None)
+    ns = ap.parse_args()
+    if ns.child:
+        cpu_fb = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+        CHILDREN[ns.child](cpu_fb)
+    else:
+        main()

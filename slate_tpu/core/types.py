@@ -256,6 +256,7 @@ class Options:
     # TPU-specific knobs (no reference analogue)
     precision: Optional[Any] = None   # compute dtype override (e.g. jnp.bfloat16)
     factor_precision: Optional[Any] = None  # low precision for *_mixed factor step
+    exact_info: bool = False          # host-refine LAPACK info indices (syncs!)
 
     def replace(self, **kw) -> "Options":
         kw = {k: _coerce_option(k, v) for k, v in kw.items()}

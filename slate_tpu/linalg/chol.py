@@ -162,7 +162,11 @@ def potrf(A, opts=None, uplo=None):
         else:
             L = _potrf_tiled_fn(n, min(opts.block_size, n), str(Af.dtype))(Af)
     info = _chol_info(L)
-    if int(info) != 0:
+    if opts.exact_info and int(info) != 0:
+        # opt-in host refinement: XLA's Cholesky NaN-fills the whole factor, so
+        # the exact first-failing-pivot index needs a host pass.  Off by
+        # default — the int() is a device→host sync on every call (hot-path
+        # hazard), and potrf stays fully jittable without it.
         info = jnp.int32(_host_chol_info(Af))
 
     out = L if the_uplo == Uplo.Lower else jnp.conj(L.T)

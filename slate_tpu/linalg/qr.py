@@ -231,26 +231,34 @@ def cholqr(A, opts=None):
                                         conjugate_a=True, transpose_a=True)
         return Q, jnp.conj(jnp.swapaxes(L, -1, -2)), info
 
+    def shifted_pass(x):
+        # shifted retry (stabilized CholeskyQR): shift Gram by ~11(mn+n^2) eps ||A||^2
+        eps = jnp.finfo(x.dtype).eps
+        shift = 11.0 * (m * n + n * (n + 1)) * eps * (jnp.linalg.norm(x) ** 2)
+        G = jnp.matmul(jnp.conj(x.T), x,
+                       precision=lax.Precision.HIGHEST) + shift * jnp.eye(
+                           n, dtype=x.dtype)
+        L = lax.linalg.cholesky(G)
+        Q = lax.linalg.triangular_solve(L, x, left_side=False, lower=True,
+                                        conjugate_a=True, transpose_a=True)
+        return Q, jnp.conj(L.T)
+
     with trace_block("cholqr", m=m, n=n):
+        # fully traceable (no host syncs): failure branches route through
+        # lax.cond, so cholqr composes under jit/vmap and never blocks dispatch
         Q1, R1, info = one_pass(a)
-        if int(info) != 0:
-            # shifted retry (stabilized CholeskyQR): shift Gram by ~11(mn+n^2) eps ||A||^2
-            eps = jnp.finfo(a.dtype).eps
-            shift = 11.0 * (m * n + n * (n + 1)) * eps * (jnp.linalg.norm(a) ** 2)
-            G = jnp.matmul(jnp.conj(a.T), a) + shift * jnp.eye(n, dtype=a.dtype)
-            L = lax.linalg.cholesky(G)
-            Q1 = lax.linalg.triangular_solve(L, a, left_side=False, lower=True,
-                                             conjugate_a=True, transpose_a=True)
-            R1 = jnp.conj(L.T)
+        Q1, R1 = lax.cond(info != 0, lambda _: shifted_pass(a),
+                          lambda _: (Q1, R1), None)
         # CholeskyQR2: re-orthogonalize
         Q2, R2, info2 = one_pass(Q1)
-        if int(info2) != 0:
-            # rank-deficient input: the Gram route cannot recover — fall back to
-            # Householder QR (the reference's MethodCholQR -> MethodGels::QR fallback)
-            Q, R = lax.linalg.qr(a, full_matrices=False)
-            return Q, R
         R = jnp.matmul(R2, R1, precision=lax.Precision.HIGHEST)
-    return Q2, R
+        # rank-deficient input: the Gram route cannot recover — fall back to
+        # Householder QR (the reference's MethodCholQR -> MethodGels::QR
+        # fallback); lax.cond executes only the taken branch
+        Q, R = lax.cond(info2 != 0,
+                        lambda _: lax.linalg.qr(a, full_matrices=False),
+                        lambda _: (Q2, R), None)
+    return Q, R
 
 
 def gels(A, BX, opts=None):

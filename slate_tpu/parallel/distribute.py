@@ -34,6 +34,13 @@ def ceil_mult(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+def lcm(a: int, b: int) -> int:
+    """Least common multiple (shard-alignment unit for (p, q) grids)."""
+    import math
+
+    return a * b // math.gcd(a, b)
+
+
 def pad2d(a: jax.Array, row_mult: int = 1, col_mult: int = 1) -> jax.Array:
     """Zero-pad the trailing 2-D dims up to multiples (no-op when already aligned)."""
     m, n = a.shape[-2:]

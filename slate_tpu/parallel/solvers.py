@@ -142,17 +142,38 @@ def posv_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
 @lru_cache(maxsize=32)
 def _cholqr_fn(mesh, precision):
     in_spec = P((ROW_AXIS, COL_AXIS), None)   # rows over the whole flattened grid
+    axes = (ROW_AXIS, COL_AXIS)
+    world = mesh.devices.size
 
     def local(a):
         # per-shard Gram contribution; psum = the listReduce tree over all ranks
-        g = lax.psum(jnp.matmul(jnp.conj(a.T), a, precision=precision),
-                     (ROW_AXIS, COL_AXIS))
-        R = jnp.conj(lax.linalg.cholesky(g).T)     # g = R^H R
-        q = lax.linalg.triangular_solve(R, a, left_side=False, lower=False)
-        return q, R
+        g = lax.psum(jnp.matmul(jnp.conj(a.T), a, precision=precision), axes)
+        Rg = jnp.conj(lax.linalg.cholesky(g).T)     # g = R^H R
+
+        def gram_path(_):
+            q = lax.linalg.triangular_solve(Rg, a, left_side=False, lower=False)
+            return q, Rg
+
+        def householder_path(_):
+            # rank-deficient input: the Gram route cannot recover — fall back
+            # to Householder QR on the gathered matrix (the reference's
+            # MethodCholQR -> QR fallback), still inside the jitted program:
+            # no host sync, lax.cond runs only the taken branch
+            n = a.shape[-1]
+            Af = lax.all_gather(a, axes, tiled=True)
+            Qf, Rf = lax.linalg.qr(Af, full_matrices=False)
+            w = lax.axis_index(axes[0]) * mesh.shape[COL_AXIS] \
+                + lax.axis_index(axes[1])
+            rows = a.shape[0]
+            q = lax.dynamic_slice(
+                Qf, (w.astype(jnp.int32) * rows, jnp.int32(0)), (rows, n))
+            return q, Rf
+
+        bad = ~jnp.all(jnp.isfinite(jnp.diagonal(Rg)))
+        return lax.cond(bad, householder_path, gram_path, None)
 
     fn = jax.shard_map(local, mesh=mesh, in_specs=in_spec,
-                       out_specs=(in_spec, P(None, None)))
+                       out_specs=(in_spec, P(None, None)), check_vma=False)
     return jax.jit(fn)
 
 
@@ -173,12 +194,6 @@ def cholqr_distributed(A: jax.Array, grid: ProcessGrid,
     mpad = Ap.shape[-2]
     Ap = jax.device_put(Ap, grid.row_spec())
     Q, R = _cholqr_fn(grid.mesh, precision)(Ap)
-    if not bool(jnp.isfinite(jnp.diagonal(R)).all()):
-        # rank-deficient input: the Gram route cannot recover — fall back to
-        # Householder QR on the gathered matrix (mirrors linalg/qr.py cholqr)
-        Qf, Rf = jnp.linalg.qr(jax.device_put(pad2d(A, world, 1), grid.replicated()))
-        Qf = jax.device_put(Qf, grid.row_spec())
-        return (Qf[:m] if mpad != m else Qf), Rf
     return (Q[:m] if mpad != m else Q), R
 
 

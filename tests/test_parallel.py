@@ -164,3 +164,128 @@ class TestCholQR:
         B = A @ X_true
         X = gels_cholqr_distributed(A, B, grid24)
         np.testing.assert_allclose(np.asarray(X), np.asarray(X_true), rtol=1e-8)
+
+
+class TestDistributedLU:
+    """Tournament-pivoted LU over the mesh (src/getrf_tntpiv.cc:161-230,
+    src/getrf.cc:22-260, src/gesv.cc analogues)."""
+
+    def test_getrf_residual(self, grid24, rng):
+        from slate_tpu.parallel import getrf_distributed
+        n, nb = 96, 8
+        A = jnp.asarray(rng.standard_normal((n, n)))
+        LU, perm, info = getrf_distributed(A, grid24, nb=nb)
+        L = jnp.tril(LU, -1) + jnp.eye(n)
+        U = jnp.triu(LU)
+        res = float(jnp.linalg.norm(A[perm] - L @ U) / jnp.linalg.norm(A))
+        assert res < 1e-13
+        assert int(info) == 0
+        # growth check: tournament pivoting bounds |L| weakly (CALU theory:
+        # elements can exceed 1, unlike strict partial pivoting, but stay small)
+        assert float(jnp.abs(L).max()) < 4.0
+
+    def test_getrf_ragged_unaligned(self, grid24, rng):
+        from slate_tpu.parallel import getrf_distributed
+        n, nb = 100, 16        # forces identity-tail padding
+        A = jnp.asarray(rng.standard_normal((n, n)))
+        LU, perm, info = getrf_distributed(A, grid24, nb=nb)
+        L = jnp.tril(LU, -1) + jnp.eye(n)
+        U = jnp.triu(LU)
+        res = float(jnp.linalg.norm(A[perm] - L @ U) / jnp.linalg.norm(A))
+        assert res < 1e-13
+        assert sorted(np.asarray(perm).tolist()) == list(range(n))
+
+    def test_gesv_solves(self, grid24, rng):
+        from slate_tpu.parallel import gesv_distributed
+        n, nrhs = 64, 5
+        A = jnp.asarray(rng.standard_normal((n, n)))
+        B = jnp.asarray(rng.standard_normal((n, nrhs)))
+        X, info = gesv_distributed(A, B, grid24, nb=8)
+        res = float(jnp.linalg.norm(A @ X - B) / jnp.linalg.norm(B))
+        assert res < 1e-10
+        assert int(info) == 0
+
+    def test_gesv_square_grid(self, grid22, rng):
+        from slate_tpu.parallel import gesv_distributed
+        n = 64
+        A = jnp.asarray(rng.standard_normal((n, n)))
+        B = jnp.asarray(rng.standard_normal((n, 3)))
+        X, info = gesv_distributed(A, B, grid22, nb=16)
+        assert float(jnp.linalg.norm(A @ X - B) / jnp.linalg.norm(B)) < 1e-10
+
+    def test_matches_single_device(self, grid24, rng):
+        """Distributed solve == single-device gesv solution (same matrix)."""
+        import slate_tpu
+        from slate_tpu.parallel import gesv_distributed
+        n = 48
+        A = jnp.asarray(rng.standard_normal((n, n)))
+        B = jnp.asarray(rng.standard_normal((n, 2)))
+        Xd, _ = gesv_distributed(A, B, grid24, nb=8)
+        Xs, _, _ = slate_tpu.gesv(A, B)
+        assert float(jnp.linalg.norm(Xd - Xs) / jnp.linalg.norm(Xs)) < 1e-9
+
+    def test_singular_info(self, grid24):
+        from slate_tpu.parallel import getrf_distributed
+        n = 32
+        A = jnp.zeros((n, n)).at[jnp.arange(n), jnp.arange(n)].set(1.0)
+        A = A.at[5, 5].set(0.0)     # exactly singular
+        LU, perm, info = getrf_distributed(A, grid24, nb=8)
+        assert int(info) != 0
+
+
+class TestDistributedQR:
+    """CAQR over the mesh (src/geqrf.cc:146-253, internal_ttqrt.cc analogues)."""
+
+    def test_tsqr_residual_orthogonality(self, grid24, rng):
+        from slate_tpu.parallel import tsqr_distributed
+        m, n = 200, 7
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        Q, R = tsqr_distributed(A, grid24)
+        assert float(jnp.linalg.norm(A - Q @ R) / jnp.linalg.norm(A)) < 1e-14
+        assert float(jnp.linalg.norm(Q.T @ Q - jnp.eye(n))) < 1e-13
+        assert float(jnp.linalg.norm(jnp.tril(R, -1))) == 0.0
+
+    def test_tsqr_ill_conditioned(self, grid24, rng):
+        """The Householder tree keeps orthogonality at cond ~ 1e12, where the
+        Gram-based CholQR route fails (the MethodGels QR/CholQR distinction)."""
+        from slate_tpu.parallel import tsqr_distributed
+        m, n = 160, 6
+        U, _ = jnp.linalg.qr(jnp.asarray(rng.standard_normal((m, n))))
+        V, _ = jnp.linalg.qr(jnp.asarray(rng.standard_normal((n, n))))
+        S = jnp.diag(jnp.asarray([1.0, 1e-3, 1e-5, 1e-8, 1e-10, 1e-12]))
+        A = U @ S @ V.T
+        Q, R = tsqr_distributed(A, grid24)
+        assert float(jnp.linalg.norm(Q.T @ Q - jnp.eye(n))) < 1e-12
+
+    def test_gels_qr(self, grid24, rng):
+        from slate_tpu.parallel import gels_qr_distributed
+        A = jnp.asarray(rng.standard_normal((120, 9)))
+        B = jnp.asarray(rng.standard_normal((120, 3)))
+        X = gels_qr_distributed(A, B, grid24)
+        Xref = jnp.linalg.lstsq(A, B)[0]
+        assert float(jnp.linalg.norm(X - Xref) / jnp.linalg.norm(Xref)) < 1e-12
+
+    def test_geqrf_2d(self, grid24, rng):
+        from slate_tpu.parallel import geqrf_distributed
+        m, n, nb = 96, 64, 8
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        Q, R = geqrf_distributed(A, grid24, nb=nb)
+        assert float(jnp.linalg.norm(A - Q @ R) / jnp.linalg.norm(A)) < 1e-13
+        assert float(jnp.linalg.norm(Q.T @ Q - jnp.eye(n))) < 1e-12
+        assert float(jnp.linalg.norm(jnp.tril(R, -1))) < 1e-14
+
+    def test_geqrf_ragged_square(self, grid22, rng):
+        from slate_tpu.parallel import geqrf_distributed
+        m, n, nb = 100, 100, 16      # unaligned, forces pad block
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        Q, R = geqrf_distributed(A, grid22, nb=nb)
+        assert float(jnp.linalg.norm(A - Q @ R) / jnp.linalg.norm(A)) < 1e-13
+        assert float(jnp.linalg.norm(Q.T @ Q - jnp.eye(n))) < 1e-12
+
+    def test_gels_caqr(self, grid24, rng):
+        from slate_tpu.parallel import gels_caqr_distributed
+        A = jnp.asarray(rng.standard_normal((96, 48)))
+        B = jnp.asarray(rng.standard_normal((96, 4)))
+        X = gels_caqr_distributed(A, B, grid24, nb=8)
+        Xref = jnp.linalg.lstsq(A, B)[0]
+        assert float(jnp.linalg.norm(X - Xref) / jnp.linalg.norm(Xref)) < 1e-11
