@@ -9,7 +9,8 @@ from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
                  geqrf, tsqr, unmlq, unmqr)
 from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr, sterf,
                   unmtr_hb2st, unmtr_he2hb)
-from .svd import (bdsqr, ge2tb, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
+from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
+                  unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest
 from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
                    tbsm)

@@ -60,23 +60,58 @@ def _safe_scale(a):
     return a * sigma.astype(a.dtype), 1.0 / sigma
 
 
-def heev(A, opts=None, uplo=None, want_vectors: bool = True):
+def heev(A, opts=None, uplo=None, want_vectors: bool = True,
+         method: str = "fused"):
     """Hermitian eigensolve (src/heev.cc). Returns (Lambda ascending, Z or None).
 
-    timers: phase map like the reference's --timer-level 2 output
-    (heev::scale/heev::solve/heev::rescale).
+    method:
+      - "fused" (default): XLA's eigh — on TPU a QDWH spectral divide & conquer
+        that is already all-matmul, the MXU-native answer to the same
+        memory-bound problem the reference's two-stage pipeline addresses.
+      - "two_stage": the reference pipeline he2hb -> hb2st -> sterf/steqr/stedc
+        -> unmtr_hb2st -> unmtr_he2hb (heev.cc:127-205), fully on-device.
+        opts.method_eig selects the tridiagonal solver (MethodEig.DC -> stedc).
+
+    timers: phase map like the reference's --timer-level 2 output.
     """
     opts = Options.make(opts)
     timers = Timers()
     a = _full_herm(A, uplo)
-    with trace_block("heev", n=a.shape[-1]):
+    n = a.shape[-1]
+    if method == "two_stage" and n < 8:
+        method = "fused"  # no meaningful band structure below one panel
+    with trace_block("heev", n=n):
         with timers.time("heev::scale"):
             a, factor = _safe_scale(a)
-        with timers.time("heev::solve"):
-            if want_vectors:
-                lam, z = jnp.linalg.eigh(a)
-            else:
-                lam, z = jnp.linalg.eigvalsh(a), None
+        if method == "two_stage":
+            nb = default_band_nb(n, opts)
+            with timers.time("heev::he2hb"):
+                band, Vs, Ts = he2hb(a, opts, nb=nb)
+            with timers.time("heev::hb2st"):
+                out = hb2st(band, kd=nb, want_vectors=want_vectors)
+            with timers.time("heev::stev"):
+                if want_vectors:
+                    d, e, Q2 = out
+                    if opts.method_eig == MethodEig.DC:
+                        lam, Zt = stedc(d, e)
+                    else:
+                        lam, Zt = steqr(d, e)
+                    with timers.time("heev::unmtr_hb2st"):
+                        z = jnp.matmul(Q2, Zt.astype(Q2.dtype),
+                                       precision=lax.Precision.HIGHEST)
+                    with timers.time("heev::unmtr_he2hb"):
+                        z = unmtr_he2hb("left", "n", Vs, Ts, z)
+                else:
+                    d, e = out
+                    lam = stedc(d, e)[0] if opts.method_eig == MethodEig.DC \
+                        else sterf(d, e)
+                    z = None
+        else:
+            with timers.time("heev::solve"):
+                if want_vectors:
+                    lam, z = jnp.linalg.eigh(a)
+                else:
+                    lam, z = jnp.linalg.eigvalsh(a), None
         with timers.time("heev::rescale"):
             lam = lam * factor
     heev.timers = timers  # exposed like the reference's driver timers
@@ -132,25 +167,63 @@ def hegv(itype: int, A, B, opts=None, uplo=None, want_vectors: bool = True):
 # ---------------------------------------------------------------------------
 
 
-def he2hb(A, opts=None, uplo=None):
-    """Stage 1: reduce Hermitian to band form (src/he2hb.cc, 729 LoC QR-panel
-    reduction with ttqrt trees).
+def default_band_nb(n: int, opts: Optional[Options] = None) -> int:
+    """Bandwidth for the two-stage reduction: the Options block size, capped so
+    small matrices still get a non-trivial band (reference uses Option::BlockSize,
+    he2hb.cc)."""
+    nb = opts.block_size if opts is not None else 256
+    return max(2, min(nb, max(2, n // 4)))
 
-    Current TPU form: ``lax.linalg.tridiagonal`` performs the full reduction to
-    tridiagonal (band = 1) in one fused XLA op — i.e. both reference stages at once,
-    the right granularity for a single device.  Returns (band_matrix, packed_reflectors,
-    taus) with band = tridiagonal.  A true nb-band blocked reduction for the
-    distributed path is tracked for a later round.
+
+def he2hb(A, opts=None, uplo=None, nb: Optional[int] = None):
+    """Stage 1: reduce Hermitian to nb-band form via blocked Householder QR
+    panels (src/he2hb.cc — QR panel + ttqrt tree + two-sided trailing update).
+
+    TPU re-design: one ``lax.fori_loop`` over block columns; each step QRs the
+    sub-panel below the band (full-height masked panel, dynamic pivot rows —
+    no ragged shapes) and applies the compact-WY block reflector two-sided to
+    the whole matrix as four MXU gemms.  Program size is O(nb), not O(nt).
+
+    Returns ``(band, Vs, Ts)`` with ``A = Q band Q^H`` where
+    ``Q = prod_j (I - Vs[j] Ts[j] Vs[j]^H)``; band has bandwidth nb (both
+    triangles kept — the dense Hermitian band).
     """
+    from . import householder as hh
+
+    opts = Options.make(opts)
     a = _full_herm(A, uplo)
-    arr, d, e, taus = lax.linalg.tridiagonal(a, lower=True)
     n = a.shape[-1]
-    band = jnp.zeros_like(a)
+    if nb is None:
+        nb = default_band_nb(n, opts)
+    if a.ndim > 2:
+        fn = lambda x: he2hb(x, opts, nb=nb)
+        for _ in range(a.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(a)
+    nt = -(-n // nb)
+    nj = max(nt - 1, 0)
+    if nj == 0:
+        return a, jnp.zeros((0, n, nb), a.dtype), jnp.zeros((0, nb, nb), a.dtype)
+
+    def body(j, carry):
+        Acur, Vs, Ts = carry
+        k0 = j * nb
+        off = k0 + nb
+        P = lax.dynamic_slice(Acur, (0, k0), (n, nb))
+        _, V, taus = hh.panel_qr_masked(P, off, nb)
+        T = hh.build_T(V, taus)
+        Acur = hh.block_apply_left(V, T, Acur, conj_q=True)
+        Acur = hh.block_apply_right(V, T, Acur)
+        Vs = lax.dynamic_update_slice(Vs, V[None], (j, 0, 0))
+        Ts = lax.dynamic_update_slice(Ts, T[None], (j, 0, 0))
+        return Acur, Vs, Ts
+
+    Vs0 = jnp.zeros((nj, n, nb), a.dtype)
+    Ts0 = jnp.zeros((nj, nb, nb), a.dtype)
+    Aout, Vs, Ts = lax.fori_loop(0, nj, body, (a, Vs0, Ts0))
     idx = jnp.arange(n)
-    band = band.at[..., idx, idx].set(d.astype(a.dtype))
-    band = band.at[..., idx[1:], idx[:-1]].set(e.astype(a.dtype))
-    band = band.at[..., idx[:-1], idx[1:]].set(jnp.conj(e).astype(a.dtype))
-    return band, arr, taus
+    band = jnp.where(jnp.abs(idx[:, None] - idx[None, :]) <= nb, Aout, 0)
+    return band, Vs, Ts
 
 
 def _apply_q(side, op, Q, C):
@@ -171,22 +244,60 @@ def _apply_q(side, op, Q, C):
     return write_back(C, out)
 
 
-def he2hb_q(reflectors, taus) -> jax.Array:
-    """Materialize the stage-1 Q from he2hb's packed reflectors: Q = diag(1, Q')
-    with Q' accumulated from the sub-diagonal Householder vectors (LAPACK unghtr
-    convention — the packing lax.linalg.tridiagonal produces)."""
-    arr = as_array(reflectors)
-    n = arr.shape[-1]
-    Qs = lax.linalg.householder_product(arr[..., 1:, : n - 1], taus)
-    Q = jnp.zeros_like(arr)
-    Q = Q.at[..., 0, 0].set(1.0)
-    return Q.at[..., 1:, 1:].set(Qs)
+def he2hb_q(Vs, Ts) -> jax.Array:
+    """Materialize the stage-1 Q from he2hb's stacked block reflectors:
+    ``Q = prod_j (I - Vs[j] Ts[j] Vs[j]^H)`` applied to the identity (ungtr
+    analogue; each step is two MXU gemms)."""
+    from . import householder as hh
+
+    Vs = as_array(Vs)
+    nj, n, _ = Vs.shape
+    Q = jnp.eye(n, dtype=Vs.dtype)
+    if nj == 0:
+        return Q
+
+    def body(jj, Q):
+        j = nj - 1 - jj
+        V = lax.dynamic_index_in_dim(Vs, j, 0, keepdims=False)
+        T = lax.dynamic_index_in_dim(Ts, j, 0, keepdims=False)
+        return hh.block_apply_left(V, T, Q)
+
+    return lax.fori_loop(0, nj, body, Q)
 
 
-def unmtr_he2hb(side, op, reflectors, taus, C, opts=None):
+def unmtr_he2hb(side, op, Vs, Ts, C, opts=None):
     """Apply the stage-1 (full -> band) orthogonal factor to C
-    (src/unmtr_he2hb.cc).  ``reflectors, taus`` are he2hb's packed outputs."""
-    return _apply_q(side, op, he2hb_q(reflectors, taus), C)
+    (src/unmtr_he2hb.cc).  ``Vs, Ts`` are he2hb's stacked block reflectors;
+    application is a fori_loop of block-reflector gemms — Q is never formed."""
+    from ..core.types import Op, Side
+    from . import householder as hh
+
+    side = Side.from_string(side) if not isinstance(side, Side) else side
+    op = Op.from_string(op) if not isinstance(op, Op) else op
+    if op not in (Op.NoTrans, Op.ConjTrans, Op.Trans):
+        raise SlateError(f"unmtr_he2hb: bad op {op}")
+    Vs, Ts = as_array(Vs), as_array(Ts)
+    c = as_array(C)
+    nj = Vs.shape[0]
+    if nj == 0:
+        return C
+    conj_q = op != Op.NoTrans
+    if op == Op.Trans and jnp.issubdtype(c.dtype, jnp.complexfloating):
+        raise SlateError("unmtr_he2hb: Op.Trans unsupported for complex; use 'c'")
+    # Q = Q_0 Q_1 ... Q_{nj-1}:  Q C / C Q^H apply blocks descending;
+    # Q^H C / C Q apply ascending.
+    descending = (side == Side.Left) == (not conj_q)
+
+    def body(jj, acc):
+        j = nj - 1 - jj if descending else jj
+        V = lax.dynamic_index_in_dim(Vs, j, 0, keepdims=False)
+        T = lax.dynamic_index_in_dim(Ts, j, 0, keepdims=False)
+        if side == Side.Left:
+            return hh.block_apply_left(V, T, acc, conj_q=conj_q)
+        return hh.block_apply_right(V, T, acc, conj_q=conj_q)
+
+    out = lax.fori_loop(0, nj, body, c)
+    return write_back(C, out)
 
 
 def unmtr_hb2st(side, op, V, C, opts=None):
@@ -197,41 +308,163 @@ def unmtr_hb2st(side, op, V, C, opts=None):
     return _apply_q(side, op, V, C)
 
 
-def hb2st(band, opts=None, want_vectors: bool = False):
-    """Stage 2: band -> real symmetric tridiagonal (src/hb2st.cc bulge chasing).
-    With he2hb already producing tridiagonal form, this extracts (d, e); a wider
-    band is reduced through the dense Householder tridiagonalization (one fused XLA
-    op — the single-device stand-in for the O(n*kd) bulge chase, which the reference
-    also confines to one rank, heev.cc:137-160)."""
-    b = as_array(band)
-    n = b.shape[-1]
+def _hb2st_chase(Afull: jax.Array, kd: int):
+    """The bulge-chasing kernel: full Hermitian band (bandwidth kd >= 2) ->
+    complex-subdiagonal tridiagonal, via the reference's three task types
+    (src/internal/internal_hebr.cc hebr1/hebr2/hebr3; scheduling
+    src/hb2st.cc:44-160) re-expressed as nested lax.fori_loops over static
+    kd-by-kd dynamic-slice windows on a zero-padded dense array.
+
+    Per sweep s (eliminating column s to tridiagonal):
+      - hebr1: reflector on rows [s+1, s+kd] zeroes A[s+2:, s]; two-sided on the
+        diagonal window.
+      - for r = 1, 2, ...: hebr2 right-applies the previous reflector to the
+        kd-by-kd window at (r*kd+1+s, (r-1)*kd+1+s) (creating the bulge), then a
+        new reflector zeroes the window's first column below its band edge;
+        hebr3 two-sides the diagonal window.  Inactive steps (past the matrix
+        edge) are redirected into the zero padding, where larfg yields tau = 0
+        — a structural no-op, no data-dependent branching.
+
+    Returns (d, e_complex, Vs, taus): reflectors stacked (n_sweeps, m_max, kd)
+    for the back-transform (disjoint row supports within a sweep, so a sweep's
+    reflectors apply as one batched rank-1 sweep in _hb2st_q).
+    """
+    from . import householder as hh
+
+    n = Afull.shape[-1]
+    b = kd
+    dt = Afull.dtype
+    N = n + 2 * b + 2
+    Ap = jnp.zeros((N, N), dt).at[:n, :n].set(Afull)
+    n_sweeps = max(n - 2, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    Vs0 = jnp.zeros((n_sweeps, m_max, b), dt)
+    taus0 = jnp.zeros((n_sweeps, m_max), dt)
+    zi, zj = n + b + 1, n + 1  # zero-land window anchors for inactive steps
+
+    def two_sided(tau, v, D):
+        D = D - jnp.conj(tau) * jnp.outer(v, jnp.conj(v) @ D)
+        return D - tau * jnp.outer(D @ v, jnp.conj(v))
+
+    def chase_body(r, inner):
+        s, Ap, Vs, taus, v_prev, tau_prev = inner
+        i = r * b + 1 + s
+        j = (r - 1) * b + 1 + s
+        active = i < n
+        ii = jnp.where(active, i, zi)
+        jj = jnp.where(active, j, zj)
+        W = lax.dynamic_slice(Ap, (ii, jj), (b, b))
+        # hebr2: right-apply previous reflector -> bulge; zero col 0 below edge
+        W = W - tau_prev * jnp.outer(W @ v_prev, jnp.conj(v_prev))
+        v, tau, _ = hh.larfg(W[:, 0])
+        W = W - jnp.conj(tau) * jnp.outer(v, jnp.conj(v) @ W)
+        Ap = lax.dynamic_update_slice(Ap, W, (ii, jj))
+        Ap = lax.dynamic_update_slice(Ap, jnp.conj(W).T, (jj, ii))
+        # hebr3: two-sided on the diagonal window
+        D = lax.dynamic_slice(Ap, (ii, ii), (b, b))
+        D = two_sided(tau, v, D)
+        Ap = lax.dynamic_update_slice(Ap, D, (ii, ii))
+        Vs = Vs.at[s, r].set(v)
+        taus = taus.at[s, r].set(tau)
+        return s, Ap, Vs, taus, v, tau
+
+    def sweep_body(s, carry):
+        Ap, Vs, taus = carry
+        # hebr1: first task of the sweep
+        W = lax.dynamic_slice(Ap, (s, s), (b + 1, b + 1))
+        x = W[1:, 0]
+        v, tau, _ = hh.larfg(x)
+        xn = x - jnp.conj(tau) * v * jnp.vdot(v, x)
+        W = W.at[1:, 0].set(xn)
+        W = W.at[0, 1:].set(jnp.conj(xn))
+        W = W.at[1:, 1:].set(two_sided(tau, v, W[1:, 1:]))
+        Ap = lax.dynamic_update_slice(Ap, W, (s, s))
+        Vs = Vs.at[s, 0].set(v)
+        taus = taus.at[s, 0].set(tau)
+        _, Ap, Vs, taus, _, _ = lax.fori_loop(
+            1, m_max, chase_body, (s, Ap, Vs, taus, v, tau))
+        return Ap, Vs, taus
+
+    Ap, Vs, taus = lax.fori_loop(0, n_sweeps, sweep_body, (Ap, Vs0, taus0))
+    T = Ap[:n, :n]
     idx = jnp.arange(n)
-    # detect content beyond the first sub/superdiagonal in EITHER triangle — the
-    # band may be lower- or upper-stored (HermitianBandMatrix supports both uplos)
-    wide_lower = n > 2 and bool(jnp.any(jnp.abs(jnp.tril(b, -2)) > 0))
-    wide_upper = n > 2 and bool(jnp.any(jnp.abs(jnp.triu(b, 2)) > 0))
-    if wide_lower or wide_upper:
-        if wide_lower:
-            full = jnp.tril(b) + jnp.conj(jnp.swapaxes(jnp.tril(b, -1), -1, -2))
-        else:
-            full = jnp.triu(b) + jnp.conj(jnp.swapaxes(jnp.triu(b, 1), -1, -2))
-        arr, d, e_c, taus = lax.linalg.tridiagonal(full, lower=True)
+    d = jnp.real(jnp.diagonal(T))
+    e_c = T[idx[1:], idx[:-1]]
+    return d, e_c, Vs, taus
+
+
+def _hb2st_q(Vs: jax.Array, taus: jax.Array, n: int, b: int) -> jax.Array:
+    """Materialize Q2 = prod_{s,r} H_{s,r} (chronological) from the chase
+    reflectors — per-sweep batched application (unmtr_hb2st.cc analogue)."""
+    from .householder import sweep_accumulate
+
+    return sweep_accumulate(Vs, taus, n, b)
+
+
+def _infer_bandwidth(b) -> int:
+    """Eagerly infer the bandwidth of a concrete band matrix (numpy; used when
+    the caller does not pass kd — requires a concrete array, not a tracer)."""
+    import numpy as np
+
+    arr = np.asarray(b)
+    n = arr.shape[-1]
+    nz = np.nonzero(np.abs(arr).sum(axis=tuple(range(arr.ndim - 2))) > 0)
+    if len(nz[0]) == 0:
+        return 1
+    return max(1, int(np.max(np.abs(nz[0] - nz[1]))))
+
+
+def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False):
+    """Stage 2: band -> real symmetric tridiagonal via bulge chasing
+    (src/hb2st.cc; task kernels src/internal/internal_hebr.cc).
+
+    ``kd`` is the (static) bandwidth; when omitted it is inferred eagerly from
+    the concrete input.  The band may be full (both triangles), lower-stored, or
+    upper-stored (HermitianBandMatrix uplos); storage is normalized first.
+    Returns (d, e) or (d, e, Q2) with band = Q2 T Q2^H, T = tridiag(d, e).
+    Like the reference, the chase runs on one device (heev.cc:137-160 confines
+    stage 2 to rank 0).
+    """
+    b_arr = as_array(band)
+    if kd is None:
+        kd = _infer_bandwidth(b_arr)
+    if b_arr.ndim > 2:
+        fn = lambda x: hb2st(x, kd=kd, opts=opts, want_vectors=want_vectors)
+        for _ in range(b_arr.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(b_arr)
+    n = b_arr.shape[-1]
+    idx = jnp.arange(n)
+    if kd > 1 and n > 2:
+        # normalize storage to the full dense Hermitian band
+        lower = jnp.tril(b_arr, -1)
+        upper = jnp.triu(b_arr, 1)
+        have_lower = jnp.any(jnp.abs(lower) > 0)
+        diag_part = jnp.zeros_like(b_arr).at[idx, idx].set(
+            jnp.diagonal(b_arr).real.astype(b_arr.dtype))
+        full_from_lower = diag_part + lower + jnp.conj(lower.T)
+        full_from_upper = diag_part + upper + jnp.conj(upper.T)
+        both = diag_part + lower + upper
+        symmetric_already = jnp.any(jnp.abs(lower) > 0) & jnp.any(jnp.abs(upper) > 0)
+        full = jnp.where(symmetric_already, both,
+                         jnp.where(have_lower, full_from_lower, full_from_upper))
+        d, e_c, Vs, taus = _hb2st_chase(full, kd)
+        e = jnp.abs(e_c)
         if not want_vectors:
-            return jnp.real(d), jnp.abs(e_c)
-        Q2 = he2hb_q(arr, taus)
-        Q2 = Q2 * _phase_vector(e_c.astype(b.dtype))[..., None, :]
-        return jnp.real(d), jnp.abs(e_c), Q2
-    d = jnp.real(jnp.diagonal(b, axis1=-2, axis2=-1))
-    e_c = b[..., idx[1:], idx[:-1]]
-    # an upper-stored tridiagonal band keeps its offdiagonal in the superdiagonal
-    e_up = b[..., idx[:-1], idx[1:]]
-    e_c = jnp.where(jnp.abs(e_c) > 0, e_c, jnp.conj(e_up))
-    # rotate away complex phases on the subdiagonal (the unitary diagonal similarity
-    # the reference's bulge-chasing accumulates into V)
+            return d, e
+        Q2 = _hb2st_q(Vs, taus, n, kd)
+        Q2 = Q2 * _phase_vector(e_c.astype(b_arr.dtype))[None, :]
+        return d, e, Q2
+    # kd == 1 (or trivial n): extraction + phase rotation only
+    d = jnp.real(jnp.diagonal(b_arr, axis1=-2, axis2=-1))
+    e_c = b_arr[idx[1:], idx[:-1]] if n > 1 else jnp.zeros((0,), b_arr.dtype)
+    if n > 1:
+        e_up = b_arr[idx[:-1], idx[1:]]
+        e_c = jnp.where(jnp.abs(e_c) > 0, e_c, jnp.conj(e_up))
     e = jnp.abs(e_c)
     if not want_vectors:
         return d, e
-    Q2 = jnp.zeros(b.shape, b.dtype).at[..., idx, idx].set(_phase_vector(e_c))
+    Q2 = jnp.zeros(b_arr.shape, b_arr.dtype).at[idx, idx].set(_phase_vector(e_c))
     return d, e, Q2
 
 

@@ -1,0 +1,211 @@
+"""Shared Householder-reflector kernels for the two-stage eig/SVD reductions.
+
+Reference analogue: ``src/internal/internal_householder.hh`` (gerfg/gerf — generate
+and apply a single reflector) and the compact-WY panel machinery inside
+``src/internal/internal_geqrf.cc`` / ``Tile_geqrf.hh``.
+
+TPU re-design notes:
+
+* Everything here is jittable with static shapes.  ``larfg`` generates a reflector
+  for a window whose pivot is element 0 (the bulge-chasing case); ``larfg_masked``
+  handles a *dynamic* pivot row inside a full-height column (the blocked panel
+  case), replacing the reference's ragged sub-panel views with masks — the XLA-
+  friendly alternative to dynamic shapes (SURVEY.md §7 hard part 5).
+* Zero-padded tails are free: a zero tail contributes nothing to the norm, the
+  reflector components there stay exactly zero, and a fully-zero column yields
+  ``tau = 0`` (H = I), so edge/padding windows degenerate to no-ops without any
+  data-dependent branching.
+* Conventions (LAPACK): ``H = I - tau v v^H`` with ``v[pivot] = 1``.
+  Left-apply ``H^H A = A - conj(tau) v (v^H A)``; right-apply
+  ``A H = A - tau (A v) v^H``.  Block form ``Q = H_0 H_1 ... = I - V T V^H`` with
+  T upper triangular from the forward column recurrence.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _sign_of(alpha_re):
+    """sign(x) with sign(0) = 1 (LAPACK larfg convention)."""
+    return jnp.where(alpha_re >= 0, 1.0, -1.0).astype(alpha_re.dtype)
+
+
+def larfg(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Generate a Householder reflector with pivot at element 0.
+
+    Returns ``(v, tau, beta)`` with ``v[0] = 1`` such that
+    ``H^H x = beta e_0`` where ``H = I - tau v v^H``.  A zero tail (or an
+    all-zero x) yields ``tau = 0`` and ``beta = x[0]`` — the no-op case that
+    makes padded windows safe.
+    """
+    alpha = x[..., 0]
+    sigma2 = jnp.sum(jnp.abs(x[..., 1:]) ** 2, axis=-1)
+    real_dt = jnp.real(x).dtype
+    is_cplx = jnp.issubdtype(x.dtype, jnp.complexfloating)
+    anorm2 = jnp.abs(alpha) ** 2 + sigma2
+    beta_mag = jnp.sqrt(anorm2)
+    beta = (-_sign_of(jnp.real(alpha)) * beta_mag).astype(real_dt)
+    if is_cplx:
+        trivial = (sigma2 == 0) & (jnp.imag(alpha) == 0)
+    else:
+        trivial = sigma2 == 0
+    safe_beta = jnp.where(beta == 0, 1.0, beta)
+    tau = jnp.where(trivial, 0.0, ((safe_beta - alpha) / safe_beta)).astype(x.dtype)
+    denom = alpha - safe_beta
+    safe_denom = jnp.where(denom == 0, 1.0, denom)
+    v = jnp.where(trivial[..., None], 0.0, x / safe_denom[..., None])
+    v = v.at[..., 0].set(1.0)
+    beta_out = jnp.where(trivial, jnp.real(alpha), beta).astype(real_dt)
+    return v.astype(x.dtype), tau, beta_out
+
+
+def larfg_masked(x: jax.Array, pivot) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reflector for a full-height column with a *dynamic* pivot row.
+
+    Zeroes ``x[pivot+1:]`` into ``x[pivot]``; rows ``< pivot`` are ignored (the
+    reflector has zeros there), replacing the reference's sub-panel view
+    (``internal_geqrf.cc:79-124`` operates on a trailing sub-column) with a mask.
+    Returns ``(v, tau, beta)`` with ``v[pivot] = 1`` and zeros above.
+    """
+    n = x.shape[-1]
+    ar = jnp.arange(n)
+    tail = jnp.where(ar > pivot, x, 0)
+    alpha = x[pivot]
+    sigma2 = jnp.sum(jnp.abs(tail) ** 2)
+    real_dt = jnp.real(x).dtype
+    is_cplx = jnp.issubdtype(x.dtype, jnp.complexfloating)
+    beta_mag = jnp.sqrt(jnp.abs(alpha) ** 2 + sigma2)
+    beta = (-_sign_of(jnp.real(alpha)) * beta_mag).astype(real_dt)
+    if is_cplx:
+        trivial = (sigma2 == 0) & (jnp.imag(alpha) == 0)
+    else:
+        trivial = sigma2 == 0
+    safe_beta = jnp.where(beta == 0, 1.0, beta)
+    tau = jnp.where(trivial, 0.0, ((safe_beta - alpha) / safe_beta)).astype(x.dtype)
+    denom = alpha - safe_beta
+    safe_denom = jnp.where(denom == 0, 1.0, denom)
+    v = jnp.where(trivial, 0.0, tail / safe_denom)
+    v = jnp.where(ar == pivot, 1.0, v).astype(x.dtype)
+    beta_out = jnp.where(trivial, jnp.real(alpha), beta).astype(real_dt)
+    return v, tau, beta_out
+
+
+def apply_left(tau, v: jax.Array, A: jax.Array) -> jax.Array:
+    """A := H^H A = A - conj(tau) v (v^H A).  v: (m,), A: (m, n)."""
+    w = jnp.einsum("i,i...->...", jnp.conj(v), A)
+    return A - jnp.conj(tau) * v[:, None] * w[None, :]
+
+
+def apply_right(tau, v: jax.Array, A: jax.Array) -> jax.Array:
+    """A := A H = A - tau (A v) v^H.  v: (n,), A: (m, n)."""
+    w = jnp.einsum("...j,j->...", A, v)
+    return A - tau * w[:, None] * jnp.conj(v)[None, :]
+
+
+def panel_qr_masked(P: jax.Array, off, nb: int):
+    """Householder QR of the rows ``off:`` of an (n, nb) panel, in place via masks.
+
+    ``off`` is a traced row offset (the reference slices a trailing sub-panel
+    instead; here the panel keeps full height and rows above ``off`` are
+    untouched).  Returns ``(R, V, taus)``: R is the transformed panel (entries
+    below the per-column pivot explicitly zeroed), V (n, nb) holds the
+    reflectors (unit pivot, zeros above), taus (nb,).
+    """
+    n, nb_ = P.shape
+    ar = jnp.arange(n)
+    V = jnp.zeros_like(P)
+    taus = jnp.zeros((nb_,), P.dtype)
+    R = P
+    for i in range(nb_):
+        p = off + i
+        v, tau, beta = larfg_masked(R[:, i], p)
+        R = apply_left(tau, v, R)
+        # exact zeros below the pivot of column i (the reflector zeroes them
+        # analytically; enforce numerically like the reference's panel)
+        R = R.at[:, i].set(jnp.where(ar > p, 0.0, R[:, i]))
+        V = V.at[:, i].set(v)
+        taus = taus.at[i].set(tau)
+    return R, V, taus
+
+
+def panel_lq_masked(P: jax.Array, off, nb: int):
+    """Householder LQ of the cols ``off:`` of an (nb, n) row-panel via masks.
+
+    Zeroes, for each row i, the entries right of column ``off + i``.  Returns
+    ``(L, V, taus)`` with V of shape (n, nb) in *column* form: column i is the
+    reflector v_i (unit pivot at row ``off + i`` of the transposed panel) such
+    that right-applying ``Q = H_0 H_1 ... = I - V T V^H`` to the row-panel gives
+    ``P Q = L`` — i.e. the same (V, taus) plug into build_T / block_apply_right.
+
+    Implemented as QR of the conjugate transpose, sharing panel_qr_masked.
+    """
+    R, V, taus = panel_qr_masked(jnp.conj(P).T, off, nb)
+    return jnp.conj(R).T, V, taus
+
+
+def build_T(V: jax.Array, taus: jax.Array, off=None) -> jax.Array:
+    """Compact-WY T factor: ``H_0 H_1 ... H_{nb-1} = I - V T V^H``.
+
+    Forward recurrence ``T[:i, i] = -tau_i T[:i, :i] (V[:, :i]^H v_i)``,
+    ``T[i, i] = tau_i`` (Tile_geqrf.hh analogue; nb is small and static so the
+    Python loop traces to O(nb) fused ops).
+    """
+    n, nb = V.shape
+    T = jnp.zeros((nb, nb), V.dtype)
+    G = jnp.matmul(jnp.conj(V).T, V, precision=lax.Precision.HIGHEST)  # (nb, nb)
+    for i in range(nb):
+        col = -taus[i] * jnp.matmul(T[:, :i], G[:i, i])
+        T = T.at[:i, i].set(col[:i])
+        T = T.at[i, i].set(taus[i])
+    return T
+
+
+def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int) -> jax.Array:
+    """Accumulate Q = prod_s prod_r H_{s,r} (chronological) from bulge-chase
+    reflectors whose supports within sweep s are the adjacent length-b blocks
+    starting at row/col ``s + 1 + r*b``.
+
+    Because supports within a sweep are disjoint, the whole sweep is one rank-m
+    update applied with a reshape to (slots, b) blocks — batched instead of the
+    reference's per-task reflector application (unmtr_hb2st.cc / unmbr_tb2bd.cc).
+    Returns the dense (n, n) Q.
+    """
+    n_sweeps, m_max, _ = Vs.shape
+    dt = Vs.dtype
+    ncols = n + m_max * b + b
+    Q = jnp.zeros((n, ncols), dt).at[:, :n].set(jnp.eye(n, dtype=dt))
+
+    def body(s, Q):
+        V = lax.dynamic_index_in_dim(Vs, s, 0, keepdims=False)      # (m_max, b)
+        t = lax.dynamic_index_in_dim(taus, s, 0, keepdims=False)    # (m_max,)
+        S = lax.dynamic_slice(Q, (0, s + 1), (n, m_max * b))
+        S = S.reshape(n, m_max, b)
+        y = jnp.einsum("nrb,rb->nr", S, V)
+        S = S - jnp.einsum("r,nr,rb->nrb", t, y, jnp.conj(V))
+        return lax.dynamic_update_slice(Q, S.reshape(n, m_max * b), (0, s + 1))
+
+    Q = lax.fori_loop(0, n_sweeps, body, Q)
+    return Q[:, :n]
+
+
+def block_apply_left(V: jax.Array, T: jax.Array, C: jax.Array,
+                     conj_q: bool = False) -> jax.Array:
+    """C := Q C (or Q^H C with conj_q) for Q = I - V T V^H, all MXU gemms."""
+    Tm = jnp.conj(T).T if conj_q else T
+    W = jnp.matmul(jnp.conj(V).T, C, precision=lax.Precision.HIGHEST)
+    return C - jnp.matmul(V, jnp.matmul(Tm, W, precision=lax.Precision.HIGHEST),
+                          precision=lax.Precision.HIGHEST)
+
+
+def block_apply_right(V: jax.Array, T: jax.Array, C: jax.Array,
+                      conj_q: bool = False) -> jax.Array:
+    """C := C Q (or C Q^H with conj_q) for Q = I - V T V^H."""
+    Tm = jnp.conj(T).T if conj_q else T
+    W = jnp.matmul(C, V, precision=lax.Precision.HIGHEST)
+    return C - jnp.matmul(jnp.matmul(W, Tm, precision=lax.Precision.HIGHEST),
+                          jnp.conj(V).T, precision=lax.Precision.HIGHEST)

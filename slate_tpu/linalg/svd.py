@@ -26,18 +26,43 @@ from .eig import _safe_scale
 from .qr import geqrf, unmqr
 
 
-def svd(A, opts=None, want_u: bool = True, want_vt: bool = True):
+def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
+        method: str = "fused"):
     """Singular value decomposition A = U S V^H (src/svd.cc).
 
     Returns (S descending, U or None, VT or None).  Tall/wide matrices take the QR/LQ
     pre-step like the reference (svd.cc:224+): for m >> n factor A = QR first and run
     the SVD on the small R, then U = Q U_R.
+
+    method="two_stage" runs the reference pipeline ge2tb -> tb2bd -> bdsqr ->
+    back-transforms (svd.cc:99-141) fully on-device; the default "fused" uses
+    XLA's all-matmul QDWH-SVD, the MXU-native equivalent.
     """
     opts = Options.make(opts)
     timers = Timers()
     a = as_array(A)
     m, n = a.shape[-2:]
     want_vectors = want_u or want_vt
+    if method == "two_stage":
+        with trace_block("svd_two_stage", m=m, n=n):
+            with timers.time("svd::scale"):
+                a, factor = _safe_scale(a)
+            k = min(m, n)
+            with timers.time("svd::ge2tb"):
+                d, e, U1, VT1 = ge2tb(a, opts)
+            with timers.time("svd::bdsqr"):
+                Sv, Ub, VTb = bdsqr(d, e, opts, want_vectors=want_vectors)
+            if want_vectors:
+                with timers.time("svd::unmbr"):
+                    U = jnp.matmul(U1, Ub.astype(U1.dtype),
+                                   precision=lax.Precision.HIGHEST)
+                    VT = jnp.matmul(VTb.astype(VT1.dtype), VT1,
+                                    precision=lax.Precision.HIGHEST)
+            else:
+                U = VT = None
+            Sv = Sv * factor
+        svd.timers = timers
+        return Sv, (U if want_u else None), (VT if want_vt else None)
     with trace_block("svd", m=m, n=n):
         with timers.time("svd::scale"):
             a, factor = _safe_scale(a)
@@ -90,81 +115,250 @@ def svd_vals(A, opts=None):
 # ---------------------------------------------------------------------------
 
 
-def ge2tb(A, opts=None):
-    """Stage 1: general -> bidiagonal via alternating left/right Householder
-    reflections (src/ge2tb.cc reduces to *band*; the single-device XLA granularity
-    goes directly to bidiagonal).  Returns (d, e, U, VT) with A = U B V^H where B is
-    upper bidiagonal: diag d, superdiag e."""
+def ge2tb(A, opts=None, nb: Optional[int] = None):
+    """Full bidiagonalization: general -> real bidiagonal, as the composition of
+    the reference's two stages (src/ge2tb.cc blocked band reduction, then
+    src/tb2bd.cc bulge chasing) — fully jitted, no host loops (the round-1 numpy
+    loop is gone).  Returns (d, e, U, VT) with A = U B V^H, B upper bidiagonal,
+    U (m, k), VT (k, n), k = min(m, n).
+
+    Wide inputs (m < n) take an LQ pre-step (A = L Q, bidiagonalize square L)
+    like the reference svd driver's pre-factor (svd.cc:224+).
+    """
+    from . import householder as hh
+
+    opts = Options.make(opts)
     a = as_array(A)
     m, n = a.shape[-2:]
     k = min(m, n)
-    # Golub-Kahan via QR sweeps expressed with XLA householder kernels:
-    # round 1 uses the fused SVD path to produce an exactly-bidiagonal equivalent:
-    # B = U1^H A V1. Here: QR of A gives R; LQ of R gives bidiagonal-ish core.
-    # For exact parity we compute the bidiagonal through jnp's internal
-    # tridiagonalization of the Jordan-Wielandt form later; current form returns
-    # the Golub-Kahan result computed by alternating Householder passes.
-    # alternating reflections, one column/row at a time (host-unrolled; stage is
-    # O(mn^2) — parity scaffolding, the fused svd() path is the fast route)
-    import numpy as np
+    if m < n:
+        # LQ pre-step: A^H = Q_l R  =>  A = R^H Q_l^H; bidiagonalize L = R^H
+        Ql, R = jnp.linalg.qr(jnp.conj(a).T, mode="reduced")  # (n, m), (m, m)
+        L = jnp.conj(R).T
+        d, e, U, VT_L = ge2tb(L, opts, nb=nb)
+        VT = jnp.matmul(VT_L, jnp.conj(Ql).T, precision=lax.Precision.HIGHEST)
+        return d, e, U, VT
+    from .eig import default_band_nb
 
-    Bh = np.array(a)
-    Uh = np.eye(m, dtype=Bh.dtype)
-    Vh = np.eye(n, dtype=Bh.dtype)
-    for j in range(k):
-        # left reflector to zero column j below diagonal
-        x = Bh[j:, j]
-        v = x.copy()
-        alpha = -np.exp(1j * np.angle(x[0])) * np.linalg.norm(x) if \
-            np.iscomplexobj(x) else -np.sign(x[0] if x[0] != 0 else 1.0) * np.linalg.norm(x)
-        v[0] -= alpha
-        nv = np.linalg.norm(v)
-        if nv > 0:
-            v = v / nv
-            Bh[j:, :] -= 2.0 * np.outer(v, v.conj() @ Bh[j:, :])
-            Uh[:, j:] -= 2.0 * np.outer(Uh[:, j:] @ v, v.conj())
-        if j < n - 2:
-            x = Bh[j, j + 1:]
-            v = x.copy().conj()
-            alpha = -np.exp(1j * np.angle(v[0])) * np.linalg.norm(v) if \
-                np.iscomplexobj(v) else -np.sign(v[0] if v[0] != 0 else 1.0) * np.linalg.norm(v)
-            v[0] -= alpha
-            nv = np.linalg.norm(v)
-            if nv > 0:
-                v = v / nv
-                Bh[:, j + 1:] -= 2.0 * np.outer(Bh[:, j + 1:] @ v, v.conj())
-                Vh[:, j + 1:] -= 2.0 * np.outer(Vh[:, j + 1:] @ v, v.conj())
-    if np.iscomplexobj(Bh):
-        # absorb the diagonal/superdiagonal phases into U and V (the LAPACK-style
-        # unitary diagonal similarity) so (d, e) are exactly real
-        for j in range(k):
-            cur = Bh[j, j]
-            if cur != 0:
-                ph = cur / abs(cur)
-                Bh[j, :] *= np.conj(ph)
-                Uh[:, j] *= ph
-            if j < k - 1:
-                ej = Bh[j, j + 1]
-                if ej != 0:
-                    ph2 = ej / abs(ej)
-                    Bh[:, j + 1] *= np.conj(ph2)
-                    Vh[:, j + 1] *= np.conj(ph2)
-    d = jnp.asarray(np.real(np.diagonal(Bh))[:k])
-    e = jnp.asarray(np.real(np.diagonal(Bh, offset=1))[: max(k - 1, 0)])
-    return d, e, jnp.asarray(Uh[:, :k]), jnp.asarray(Vh.conj().T[:k, :])
+    nb_eff = default_band_nb(k, opts) if nb is None else nb
+    nb_eff = int(max(2, min(nb_eff, max(2, k - 1))))
+    band, Uf, Vf = ge2tb_band(a, opts, nb=nb_eff)
+    if k > 2:
+        d, e, U2, VT2 = tb2bd(band[..., :k, :k], nb_eff, opts,
+                              want_vectors=True)
+    else:
+        # k <= 2: the band already is the bidiagonal; just normalize phases
+        sq = band[:k, :k]
+        d_c = jnp.diagonal(sq)
+        e_c = jnp.diagonal(sq, offset=1)
+        pu, pw = _bidiag_phases(d_c, e_c, a.dtype)
+        d, e = jnp.abs(d_c), jnp.abs(e_c)
+        U2 = jnp.diag(pu)
+        VT2 = jnp.conj(jnp.diag(pw)).T
+    # U = (prod Qu)[:, :k] @ U2 ; VT = VT2 @ (prod Qv)^H[:k, :]
+    U = jnp.zeros((m, k), a.dtype).at[:k, :k].set(U2.astype(a.dtype))
+    U = unmbr_ge2tb_factors("left", "n", Uf, U)
+    Vh = jnp.zeros((n, k), a.dtype).at[:k, :k].set(
+        jnp.conj(VT2.astype(a.dtype)).T)
+    Vfull = unmbr_ge2tb_factors("left", "n", Vf, Vh)
+    VT = jnp.conj(Vfull).T
+    return d, e, U, VT
+
+
+def ge2tb_band(A, opts=None, nb: Optional[int] = None):
+    """Stage 1 proper: general -> *upper band* (bandwidth nb) via alternating
+    blocked QR column panels and LQ row panels (src/ge2tb.cc — the reference
+    stops at the band exactly like this; tb2bd chases it to bidiagonal).
+
+    One ``lax.fori_loop`` over block indices; each step QRs the diagonal-pivot
+    column panel (masked dynamic pivots, no ragged shapes), left-applies the
+    compact-WY reflector to the whole matrix, then LQs the row panel with
+    pivots one block to the right and right-applies — all MXU gemms, program
+    size O(nb).  Requires m >= n (the svd driver LQ-pre-steps wide inputs).
+
+    Returns ``(band, (Vu, Tu), (Vv, Tv))`` with ``A = U band V^H``,
+    ``U = prod_j (I - Vu[j] Tu[j] Vu[j]^H)``, ``V = prod_j (I - Vv[j] Tv[j] Vv[j]^H)``.
+    """
+    from . import householder as hh
+    from .eig import default_band_nb
+
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    if m < n:
+        raise ValueError("ge2tb_band requires m >= n; LQ-pre-step wide inputs")
+    k = n
+    if nb is None:
+        nb = default_band_nb(k, opts)
+    nt = max(-(-k // nb), 1)
+    # pad so the last panel's slice never clamps (dynamic_slice clamps
+    # out-of-bounds starts, which would silently grab shifted columns)
+    mp, np_ = m + nb, n + nb
+    Apad = jnp.zeros((mp, np_), a.dtype).at[:m, :n].set(a)
+
+    def body(j, carry):
+        Acur, Vu, Tu, Vv, Tv = carry
+        k0 = j * nb
+        # QR panel: pivots on the diagonal, zero below it
+        P = lax.dynamic_slice(Acur, (0, k0), (mp, nb))
+        _, V, taus = hh.panel_qr_masked(P, k0, nb)
+        T = hh.build_T(V, taus)
+        Acur = hh.block_apply_left(V, T, Acur, conj_q=True)
+        Vu = lax.dynamic_update_slice(Vu, V[None], (j, 0, 0))
+        Tu = lax.dynamic_update_slice(Tu, T[None], (j, 0, 0))
+        # LQ panel: pivots one block right of the diagonal, zero beyond them
+        Prow = lax.dynamic_slice(Acur, (k0, 0), (nb, np_))
+        _, Vr, tausr = hh.panel_lq_masked(Prow, k0 + nb, nb)
+        Tr = hh.build_T(Vr, tausr)
+        Acur = hh.block_apply_right(Vr, Tr, Acur)
+        Vv = lax.dynamic_update_slice(Vv, Vr[None], (j, 0, 0))
+        Tv = lax.dynamic_update_slice(Tv, Tr[None], (j, 0, 0))
+        return Acur, Vu, Tu, Vv, Tv
+
+    Vu0 = jnp.zeros((nt, mp, nb), a.dtype)
+    Tu0 = jnp.zeros((nt, nb, nb), a.dtype)
+    Vv0 = jnp.zeros((nt, np_, nb), a.dtype)
+    Tv0 = jnp.zeros((nt, nb, nb), a.dtype)
+    Aout, Vu, Tu, Vv, Tv = lax.fori_loop(0, nt, body,
+                                         (Apad, Vu0, Tu0, Vv0, Tv0))
+    ri = jnp.arange(m)[:, None]
+    ci = jnp.arange(n)[None, :]
+    band = jnp.where((ci >= ri) & (ci - ri <= nb), Aout[:m, :n], 0)
+    return band, (Vu[:, :m, :], Tu), (Vv[:, :n, :], Tv)
+
+
+def unmbr_ge2tb_factors(side, op, factors, C):
+    """Apply a stacked block-reflector factor from ge2tb_band ((Vu,Tu) for U,
+    (Vv,Tv) for V) to C without materializing Q (src/unmbr_ge2tb.cc)."""
+    from .eig import unmtr_he2hb
+
+    Vs, Ts = factors
+    return unmtr_he2hb(side, op, Vs, Ts, C)
+
+
+def _tb2bd_chase(Bfull: jax.Array, kd: int):
+    """Bidiagonal bulge chasing: square upper band (bandwidth kd >= 2) ->
+    complex bidiagonal, via the reference's three task types
+    (src/internal/internal_gebr.cc gebr1/gebr2/gebr3; windows src/tb2bd.cc:77-131)
+    as nested lax.fori_loops over static dynamic-slice windows on a padded array.
+
+    Per sweep s:
+      - gebr1 on the (kd+1)-by-kd window at (s, s+1): a right reflector zeroes
+        row s beyond the superdiagonal, then a left reflector zeroes column s+1
+        below its first subdiagonal row.
+      - per block r >= 1: gebr2 on the kd-by-kd superdiagonal window at
+        ((r-1)kd+1+s, r*kd+1+s) left-applies the previous u (bulge), then a new
+        right reflector zeroes its first row; gebr3 on the diagonal window at
+        (r*kd+1+s) right-applies that v and generates a left u zeroing its
+        first column.  Inactive steps land in zero padding (tau = 0 no-ops).
+
+    Returns (d_c, e_c, Us, tauus, Vsr, tauvs): complex bi-diagonal plus both
+    reflector families for the back-transforms (disjoint supports per sweep).
+    """
+    from . import householder as hh
+
+    n = Bfull.shape[-1]
+    b = kd
+    dt = Bfull.dtype
+    N = n + 2 * b + 2
+    Bp = jnp.zeros((N, N), dt).at[:n, :n].set(Bfull)
+    n_sweeps = max(n - 1, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    Us0 = jnp.zeros((n_sweeps, m_max, b), dt)
+    tauus0 = jnp.zeros((n_sweeps, m_max), dt)
+    Vs0 = jnp.zeros((n_sweeps, m_max, b), dt)
+    tauvs0 = jnp.zeros((n_sweeps, m_max), dt)
+    zi, zj = n + b + 1, n + 1
+
+    def chase_body(r, inner):
+        s, Bp, Us, tauus, Vs, tauvs, u_prev, tauu_prev = inner
+        i = (r - 1) * b + 1 + s
+        j = r * b + 1 + s
+        active = j < n
+        ii = jnp.where(active, i, zj)
+        jj = jnp.where(active, j, zi)
+        # gebr2: superdiagonal window — left-apply previous u, new right v
+        W = lax.dynamic_slice(Bp, (ii, jj), (b, b))
+        W = hh.apply_left(tauu_prev, u_prev, W)
+        v, tauv, _ = hh.larfg(jnp.conj(W[0, :]))
+        W = hh.apply_right(tauv, v, W)
+        Bp = lax.dynamic_update_slice(Bp, W, (ii, jj))
+        # gebr3: diagonal window — right-apply v, new left u
+        D = lax.dynamic_slice(Bp, (jj, jj), (b, b))
+        D = hh.apply_right(tauv, v, D)
+        u, tauu, _ = hh.larfg(D[:, 0])
+        D = hh.apply_left(tauu, u, D)
+        Bp = lax.dynamic_update_slice(Bp, D, (jj, jj))
+        Vs = Vs.at[s, r].set(v)
+        tauvs = tauvs.at[s, r].set(tauv)
+        Us = Us.at[s, r].set(u)
+        tauus = tauus.at[s, r].set(tauu)
+        return s, Bp, Us, tauus, Vs, tauvs, u, tauu
+
+    def sweep_body(s, carry):
+        Bp, Us, tauus, Vs, tauvs = carry
+        # gebr1: (b+1, b) window at (s, s+1)
+        W = lax.dynamic_slice(Bp, (s, s + 1), (b + 1, b))
+        v, tauv, _ = hh.larfg(jnp.conj(W[0, :]))
+        W = hh.apply_right(tauv, v, W)
+        y = W[1:, 0]
+        u, tauu, _ = hh.larfg(y)
+        W = W.at[1:, :].set(hh.apply_left(tauu, u, W[1:, :]))
+        Bp = lax.dynamic_update_slice(Bp, W, (s, s + 1))
+        Vs = Vs.at[s, 0].set(v)
+        tauvs = tauvs.at[s, 0].set(tauv)
+        Us = Us.at[s, 0].set(u)
+        tauus = tauus.at[s, 0].set(tauu)
+        _, Bp, Us, tauus, Vs, tauvs, _, _ = lax.fori_loop(
+            1, m_max, chase_body, (s, Bp, Us, tauus, Vs, tauvs, u, tauu))
+        return Bp, Us, tauus, Vs, tauvs
+
+    Bp, Us, tauus, Vs, tauvs = lax.fori_loop(
+        0, n_sweeps, sweep_body, (Bp, Us0, tauus0, Vs0, tauvs0))
+    B = Bp[:n, :n]
+    idx = jnp.arange(n)
+    d_c = B[idx, idx]
+    e_c = B[idx[:-1], idx[1:]] if n > 1 else jnp.zeros((0,), dt)
+    return d_c, e_c, Us, tauus, Vs, tauvs
+
+
+def _bidiag_phases(d_c, e_c, dt):
+    """Unitary diagonal phases (pu, pw) with B_c = diag(pu) B_real diag(pw)^H:
+    pu_j conj(pw_j) = phase(d_j), pu_j conj(pw_{j+1}) = phase(e_j)."""
+    def phase(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag > 0, x / jnp.where(mag > 0, mag, 1), 1).astype(dt)
+
+    pd, pe = phase(d_c), phase(e_c)
+    # w_0 = 1; u_j = pd_j w_j; w_{j+1} = conj(pe_j) u_j
+    pw = jnp.concatenate([jnp.ones((1,), dt),
+                          jnp.cumprod(jnp.conj(pe) * pd[:-1])]) \
+        if d_c.shape[-1] > 1 else jnp.ones(d_c.shape, dt)
+    pu = pd * pw
+    return pu, pw
 
 
 def tb2bd(band, kd, opts=None, want_vectors: bool = False):
-    """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc).  For the kd=1
-    output of ge2tb this is the identity extraction of (d, e); a wider band (kd > 1)
-    is re-bidiagonalized through the ge2tb Householder pass — correct for any kd,
-    with the O(n*kd) bulge chase tracked for a later round.
+    """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc; kernels
+    src/internal/internal_gebr.cc).  For kd=1 this is the (phase-normalized)
+    identity extraction; kd >= 2 runs the real windowed chase.
 
     With want_vectors, returns (d, e, U2, VT2) such that band = U2 B VT2."""
+    from . import householder as hh
+
     b = as_array(band)
     if kd > 1:
-        d, e, U2, VT2 = ge2tb(b, opts)
-        return (d, e, U2, VT2) if want_vectors else (d, e)
+        kb = min(b.shape[-2:])
+        sq = b[..., :kb, :kb]
+        d_c, e_c, Us, tauus, Vs, tauvs = _tb2bd_chase(sq, kd)
+        pu, pw = _bidiag_phases(d_c, e_c, b.dtype)
+        d, e = jnp.abs(d_c), jnp.abs(e_c)
+        if not want_vectors:
+            return d, e
+        U2 = hh.sweep_accumulate(Us, tauus, kb, kd) * pu[None, :]
+        V2 = hh.sweep_accumulate(Vs, tauvs, kb, kd) * pw[None, :]
+        VT2 = jnp.conj(V2).T
+        return d, e, U2, VT2
     k = min(b.shape[-2:])
     d_c = jnp.diagonal(b, axis1=-2, axis2=-1)[:k]
     e_c = jnp.diagonal(b, offset=1, axis1=-2, axis2=-1)[: k - 1]

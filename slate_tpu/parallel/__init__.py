@@ -18,7 +18,6 @@ from .distribute import (block_spec, distribute, replicate, redistribute,
 from .summa import gemm_distributed, gemm_allgather, gemm_ring, summa_gemm
 from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
                       cholqr_distributed, gels_cholqr_distributed)
-from .lu_dist import (getrf_distributed, getrs_distributed, gesv_distributed,
-                      trsm_distributed_upper)
+from .lu_dist import (getrf_distributed, getrs_distributed, gesv_distributed)
 from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       geqrf_distributed, gels_caqr_distributed)

@@ -39,7 +39,7 @@ TPU re-design (not a translation):
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -262,34 +262,7 @@ def getrs_distributed(LU: jax.Array, perm: jax.Array, B: jax.Array,
     L = jnp.tril(LU, -1) + eye
     U = jnp.triu(LU)
     Y = trsm_distributed(L, Bp, grid, lower=True, conj_trans=False)
-    return trsm_distributed_upper(U, Y, grid)
-
-
-def trsm_distributed_upper(U: jax.Array, B: jax.Array, grid: ProcessGrid):
-    """Distributed left upper-triangular solve (pads with identity tail)."""
-    from .distribute import pad2d
-
-    n, nrhs = B.shape[-2:]
-    mult = _lcm(grid.p, grid.q)
-    npad = ceil_mult(n, mult)
-    if npad > n:
-        Up = jnp.zeros((npad, npad), U.dtype).at[:n, :n].set(U)
-        idx = jnp.arange(n, npad)
-        Up = Up.at[idx, idx].set(1)
-        Bp = jnp.pad(B, ((0, npad - n), (0, 0)))
-    else:
-        Up, Bp = U, B
-    Bp = pad2d(Bp, 1, grid.q)
-    cpad = Bp.shape[-1]
-    Up = jax.device_put(Up, grid.spec())
-    Bp = jax.device_put(Bp, grid.spec())
-
-    @partial(jax.jit, out_shardings=grid.spec())
-    def solve(Up, Bp):
-        return lax.linalg.triangular_solve(Up, Bp, left_side=True, lower=False)
-
-    X = solve(Up, Bp)
-    return X[:n, :nrhs] if (npad != n or cpad != nrhs) else X
+    return trsm_distributed(U, Y, grid, lower=False, conj_trans=False)
 
 
 def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,

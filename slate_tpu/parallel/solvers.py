@@ -66,9 +66,7 @@ def _potrf_dist_fn(mesh, n: int, nb: int, dtype_str: str):
     return jax.jit(fn, in_shardings=spec, out_shardings=spec)
 
 
-def _lcm(a: int, b: int) -> int:
-    import math
-    return a * b // math.gcd(a, b)
+from .distribute import lcm as _lcm
 
 
 def _pad_spd(Af: jax.Array, mult: int):
