@@ -506,8 +506,10 @@ def steqr(d, e, Z: Optional[jax.Array] = None, opts=None):
 
 
 def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):
-    """Divide & conquer tridiagonal eigensolver (src/stedc.cc + stedc_* family,
-    1.8 kLoC distributed D&C).  Single-device round-1 form routes through the same
-    fused path as steqr; the distributed merge/deflate/secular stages are tracked
-    for a later round."""
-    return steqr(d, e, Z, opts)
+    """Divide & conquer tridiagonal eigensolver (src/stedc.cc + stedc_* family).
+    Real D&C: host-side recursion tree of jitted rank-one merges with
+    bracketed-bisection secular solves and Gu-corrected Loewner eigenvectors —
+    see ``linalg/stedc.py`` for the TPU-shaped deflation design."""
+    from .stedc import stedc as _stedc_impl
+
+    return _stedc_impl(d, e, Z, opts)
