@@ -58,7 +58,17 @@ def select_algo_gemm(A, B, C, opts: Options) -> MethodGemm:
 
 def gemm(alpha, A, B, beta, C, opts=None):
     """C = alpha op(A) op(B) + beta C (src/gemm.cc:87)."""
+    from .core.matrix import distribution_grid
+
     opts = Options.make(opts)
+    grid = distribution_grid(A, B, C)
+    if grid is not None:
+        # wrappers bound to a >1-device grid run the SUMMA pipeline over it
+        # (scalapack_gemm.cc builds on the BLACS grid the same way)
+        from .parallel import summa
+
+        return write_back(C, summa.summa_gemm(alpha, A, B, beta, C, opts,
+                                              grid=grid))
     method = select_algo_gemm(A, B, C, opts)
     if method == MethodGemm.SUMMA:
         # explicit shard_map pipeline; requires distributed wrappers

@@ -7,5 +7,5 @@ from .types import (Diag, GridOrder, Layout, MethodCholQR, MethodEig, MethodGels
 from .matrix import (BandMatrix, BaseBandMatrix, BaseMatrix, BaseTrapezoidMatrix,
                      HermitianBandMatrix, HermitianMatrix, Matrix, MatrixStorage,
                      SymmetricMatrix, TrapezoidMatrix, TriangularBandMatrix,
-                     TriangularMatrix, as_array, write_back)
+                     TriangularMatrix, as_array, distribution_grid, write_back)
 from . import grid as func  # reference include/slate/func.hh namespace name

@@ -68,6 +68,12 @@ class MatrixStorage:
         self.tile_rank = tile_rank or grid_funcs.process_2d_grid(self.order, self.p, self.q)
         self.grid = grid          # ProcessGrid (parallel/mesh.py) or None
         self.kind = kind
+        # A real (>1 device) grid places the backing array at construction —
+        # the reference ties the distribution into every matrix the same way
+        # (MatrixStorage.hh:494-511 installs tileRank/tileDevice in the ctor).
+        if (grid is not None and getattr(grid, "size", 1) > 1
+                and hasattr(grid, "spec") and getattr(array, "ndim", 0) == 2):
+            self.array = jax.device_put(array, grid.spec())
 
     @property
     def m(self) -> int:
@@ -528,6 +534,26 @@ class HermitianBandMatrix(BaseBandMatrix):
 # ---------------------------------------------------------------------------
 # Helpers used across drivers
 # ---------------------------------------------------------------------------
+
+
+def distribution_grid(*operands):
+    """The shared ProcessGrid (size > 1) attached to any wrapper operand, or None.
+
+    Drivers consult this to route to the ``parallel`` implementations — the
+    TPU form of the reference consuming ``tileRank``/``tileDevice`` installed
+    at matrix construction (MatrixStorage.hh:494-511).  Mixing wrappers bound
+    to different grids is an error, like mixing BLACS contexts.
+    """
+    g = None
+    for op in operands:
+        if isinstance(op, BaseMatrix):
+            og = op.storage.grid
+            if og is not None and getattr(og, "size", 1) > 1:
+                if g is not None and og is not g:
+                    raise SlateError(
+                        "operands are distributed on different process grids")
+                g = og
+    return g
 
 
 def as_array(A) -> jax.Array:

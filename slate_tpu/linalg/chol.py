@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.exceptions import SlateError
-from ..core.matrix import BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array, write_back
+from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array,
+                           distribution_grid, write_back)
 from ..core.types import Options, Target, Uplo
 from ..ops import blas3
 from ..utils.trace import trace_block
@@ -156,8 +157,16 @@ def potrf(A, opts=None, uplo=None):
     if target == Target.Auto:
         target = Target.XLA  # single fused factorization; Tiled for distributed runs
 
+    grid = distribution_grid(A)
     with trace_block("potrf", n=n, nb=opts.block_size, target=str(target)):
-        if target == Target.XLA:
+        if grid is not None:
+            # the wrapper carries a >1-device process grid: run the sharded
+            # factorization over it (reference: distribution installed at
+            # construction is consumed by every driver)
+            from ..parallel import potrf_distributed
+
+            L = potrf_distributed(Af, grid, nb=min(opts.block_size, n))
+        elif target == Target.XLA:
             L = jnp.tril(lax.linalg.cholesky(Af))
         else:
             L = _potrf_tiled_fn(n, min(opts.block_size, n), str(Af.dtype))(Af)

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.exceptions import SlateError
-from ..core.matrix import BaseMatrix, as_array, write_back
+from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodLU, Options, Target
 from ..utils.trace import trace_block
 from .chol import _ir_solve
@@ -219,6 +219,19 @@ def getrf(A, opts=None):
         return getrf_tntpiv(A, opts)
     if method != MethodLU.PartialPiv:
         raise SlateError(f"unsupported MethodLU {method}")
+
+    grid = distribution_grid(A)
+    a_chk = as_array(A)
+    if grid is not None and a_chk.shape[-2] == a_chk.shape[-1]:
+        # wrapper bound to a >1-device grid: tournament-pivoted distributed LU
+        # (the mesh form of getrf_tntpiv; reference getrf.cc consumes the
+        # construction-time distribution the same way).  Rectangular LU has no
+        # mesh kernel yet and falls through to the single-device path.
+        from ..parallel import getrf_distributed
+
+        lu_, perm, info = getrf_distributed(a_chk, grid, nb=opts.block_size)
+        write_back(A, lu_)
+        return lu_, perm, info
 
     a = as_array(A)
     m, n = a.shape[-2:]

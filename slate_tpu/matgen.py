@@ -386,9 +386,11 @@ def generate_matrix(kind: str, m: int, n: Optional[int] = None, *,
         raise SlateError(f"unhandled kind '{kind}'")
 
     if dominant:
+        # the reference bumps the diagonal by n BEFORE the sigma_max scaling
+        # (generate_type_rand.hh:70-83), so the bump scales with the matrix
         mn = min(m, n)
         idx = jnp.arange(mn)
-        A = A.at[idx, idx].add(jnp.asarray(n, dtype))   # generate_type_rand.hh:70-78
+        A = A.at[idx, idx].add(jnp.asarray(n * sigma_max, dtype))
     if zero_col is not None:
         col = int(round(zero_col * (n - 1))) if isinstance(zero_col, float) else zero_col
         if not 0 <= col < n:
@@ -438,7 +440,9 @@ def generate_tile(kind: str, i0: int, j0: int, mb: int, nb: int, m: int, n: int,
         I, J = jnp.meshgrid(jnp.arange(i0, i0 + mb), jnp.arange(j0, j0 + nb),
                             indexing="ij")
         if dominant:
-            tile = jnp.where((I == J) & (I < min(m, n)), tile + n, tile)
+            # bump scaled by sigma_max to match the reference's pre-scale order
+            tile = jnp.where((I == J) & (I < min(m, n)),
+                             tile + n * sigma_max, tile)
         if zero_col is not None:
             col = (int(round(zero_col * (n - 1))) if isinstance(zero_col, float)
                    else zero_col)

@@ -38,11 +38,34 @@ def _order_code(order) -> int:
 _FAIL_STAMP = os.path.join(_NATIVE_DIR, ".build_failed")
 
 
+def _src_fingerprint() -> str:
+    """Newest mtime over the native sources; keys the fail stamp so a stamp
+    from an older (or transiently broken) tree doesn't suppress builds of a
+    changed one."""
+    try:
+        ms = [os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+              for f in os.listdir(_NATIVE_DIR)
+              if f.endswith((".cpp", ".cc", ".c", ".h", ".hpp")) or f == "Makefile"]
+        return repr(max(ms)) if ms else "0"
+    except OSError:
+        return "0"
+
+
+def _stamp_suppresses() -> bool:
+    try:
+        with open(_FAIL_STAMP) as f:
+            return f.read().strip() == _src_fingerprint()
+    except OSError:
+        return False
+
+
 def build() -> bool:
-    """Compile native/libslate_rt.so with make.  Called once at import (unless
-    SLATE_TPU_NATIVE=0) so the compile never lands inside a hot/traced path;
+    """Compile native/libslate_rt.so with make.  Runs lazily on the first
+    native call (never at import — an import must not spawn a compiler);
     callers can also invoke it explicitly after a clean.  A failed attempt is
-    stamped so later imports don't re-pay the compile; explicit build() retries."""
+    stamped with the source fingerprint so later sessions don't re-pay a
+    doomed compile, but any source change invalidates the stamp; explicit
+    build() always retries."""
     global _tried
     try:
         proc = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
@@ -56,7 +79,8 @@ def build() -> bool:
             if os.path.exists(_FAIL_STAMP):
                 os.unlink(_FAIL_STAMP)
         else:
-            open(_FAIL_STAMP, "w").close()
+            with open(_FAIL_STAMP, "w") as f:
+                f.write(_src_fingerprint())
     except OSError:
         pass
     return ok
@@ -64,13 +88,27 @@ def build() -> bool:
 
 def _should_autobuild() -> bool:
     import shutil
-    return (os.environ.get("SLATE_TPU_NATIVE", "1") != "0"
-            and not os.path.exists(_LIB_PATH)
-            and os.path.isdir(_NATIVE_DIR)
-            and os.access(_NATIVE_DIR, os.W_OK)
-            and not os.path.exists(_FAIL_STAMP)
-            and shutil.which("make") is not None
-            and shutil.which(os.environ.get("CXX", "g++")) is not None)
+    if (os.environ.get("SLATE_TPU_NATIVE", "1") == "0"
+            or os.path.exists(_LIB_PATH)
+            or not os.path.isdir(_NATIVE_DIR)
+            or not os.access(_NATIVE_DIR, os.W_OK)
+            or shutil.which("make") is None
+            or shutil.which(os.environ.get("CXX", "g++")) is None):
+        return False
+    if _stamp_suppresses():
+        global _warned_stamp
+        if not _warned_stamp:
+            _warned_stamp = True
+            import warnings
+            warnings.warn(
+                "slate_tpu native build previously failed for these sources "
+                f"({_FAIL_STAMP} present); using pure-Python fallbacks. "
+                "Call slate_tpu.native.build() to retry.")
+        return False
+    return True
+
+
+_warned_stamp = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -78,6 +116,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
+    if not os.path.exists(_LIB_PATH) and _should_autobuild():
+        build()           # lazy first-use build (ADVICE: not at import time)
+        _tried = True     # build() cleared it so a fresh .so is picked up here
     if not os.path.exists(_LIB_PATH):
         return None
     try:
@@ -290,8 +331,8 @@ def trace_dump(path: str) -> bool:
     return int(lib.srt_trace_dump(path.encode())) == 0
 
 
-# build once at import time (outside any traced/hot path); opt out with
-# SLATE_TPU_NATIVE=0 (pure-Python fallbacks remain fully functional); failed
-# attempts are stamped so imports never re-pay a doomed compile
-if _should_autobuild():
-    build()
+# NOTE: no import-time build — the native library compiles lazily on the first
+# native call (_load), so `import slate_tpu` never spawns a compiler.  Opt out
+# entirely with SLATE_TPU_NATIVE=0 (pure-Python fallbacks remain functional);
+# a failed attempt is stamped keyed to the source fingerprint, so only the
+# same broken tree is suppressed and a warning is emitted once.

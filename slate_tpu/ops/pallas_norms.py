@@ -25,12 +25,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-try:  # pallas TPU backend (present in all jax>=0.4.3x installs)
+try:  # both pallas and its TPU backend are optional: a jax build without
+    # pallas must not break `import slate_tpu` (the XLA norm path needs none)
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover - environment-specific
+    pl = None
     pltpu = None
     _HAS_PALLAS = False
 

@@ -222,6 +222,9 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     n = A.shape[-1]
     slate_assert(A.ndim == 2 and A.shape[0] == A.shape[1],
                  "getrf_distributed expects a square matrix")
+    # clamp the block size so the padding unit never dwarfs the problem
+    # (default nb=256 on a small matrix would otherwise pad to nb*lcm(p,q))
+    nb = max(1, min(nb, n))
     unit = nb * _lcm(grid.p, grid.q)
     npad = ceil_mult(n, unit)
     if npad > n:

@@ -210,6 +210,7 @@ def geqrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """
     m, n = A.shape[-2:]
     slate_assert(m >= n, "geqrf_distributed expects m >= n")
+    nb = max(1, min(nb, n))  # keep the pad unit proportional to the problem
     npad = ceil_mult(n, nb * grid.q)
     runit = nb * grid.p
     # rows must fit both the real matrix and the unit-column pad block

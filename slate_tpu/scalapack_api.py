@@ -4,17 +4,24 @@ The reference exports ``pdgemm``/``pdpotrf``-style entry points that build SLATE
 matrices ``fromScaLAPACK`` on the caller's BLACS grid (scalapack_api/
 scalapack_gemm.cc:14-27 etc.).  The TPU equivalent of a BLACS process grid is a
 ``ProcessGrid`` over the device mesh (parallel/mesh.py): ``gridinit(p, q)`` plays
-``Cblacs_gridinit``, and the p* routines shard their operands over that grid,
-using the explicit shard_map SUMMA path for gemm and GSPMD sharding for the
-factorizations.  With no grid initialized (or a 1x1 grid) everything runs
-single-device, exactly like running ScaLAPACK on one process.
+``Cblacs_gridinit``.
+
+On a >1-device grid these families run genuinely distributed implementations
+from ``slate_tpu.parallel``: gemm (SUMMA all-gather), potrf/posv (sharded
+right-looking Cholesky), getrf/gesv/getrs (tournament-pivoted LU over the
+mesh), gels (2-D CAQR), trsm (sharded triangular solve; left side).  Variants
+without a mesh kernel (right-side trsm, transposed getrs, underdetermined
+gels) and all remaining routines fall back to the shared single-device driver
+layer — still correct, just not distributed.  With no grid initialized (or a
+1x1 grid) everything runs single-device, exactly like ScaLAPACK on one process.
 
 Same routine coverage as the reference's scalapack_api: gemm hemm symm herk syrk
 her2k syr2k trmm trsm lange lanhe lansy lantr gesv gesv_mixed getrf getrs getri
 gecon posv potrf potrs potri pocon trcon gels heev heevd syev syevd gesvd — all
 with the p<type> prefix (pdgemm, psposv, pzheev, ...).
 
-Env tuning: ``SLATE_SCALAPACK_NB`` sets the distribution block size.
+Env tuning: ``SLATE_SCALAPACK_NB`` sets the distribution block size consumed by
+the distributed p* routines.
 """
 
 from __future__ import annotations
@@ -68,11 +75,31 @@ def current_grid():
 
 
 def _nb() -> int:
+    """Distribution block size for the p* routines (SLATE_SCALAPACK_NB,
+    mirroring the reference's lapack_api/scalapack env tuning)."""
     return int(os.environ.get("SLATE_SCALAPACK_NB", "256"))
 
 
 def _ceil_mult(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _jnp(x):
+    return jax.numpy.asarray(x)
+
+
+def _sym_full(uplo, a):
+    """Full Hermitian array from the stored triangle (fromScaLAPACK builds the
+    SLATE HermitianMatrix the same way)."""
+    if uplo.lower().startswith("l"):
+        lo = np.tril(a, -1)
+        return np.diag(np.diagonal(a)) + lo + lo.conj().T
+    up = np.triu(a, 1)
+    return np.diag(np.diagonal(a)) + up + up.conj().T
+
+
+def _finite_info(x) -> int:
+    return 0 if bool(np.isfinite(np.asarray(x)).all()) else 1
 
 
 def _pgemm_distributed(dt, transa, transb, alpha, a, b, beta, c):
@@ -94,18 +121,147 @@ def _pgemm_distributed(dt, transa, transb, alpha, a, b, beta, c):
     pm, pk, pn = _ceil_mult(m, p), _ceil_mult(k, p * q), _ceil_mult(n, q)
     ap = np.zeros((pm, pk), a.dtype); ap[:m, :k] = a
     bp = np.zeros((pk, pn), b.dtype); bp[:k, :n] = b
-    out = gemm_allgather(jax.numpy.asarray(ap), jax.numpy.asarray(bp), _grid)
+    out = gemm_allgather(_jnp(ap), _jnp(bp), _grid)
     return np.asarray(alpha * np.asarray(out)[:m, :n] + beta * c)
+
+
+def _ppotrf_distributed(dt, uplo, a):
+    from .parallel import potrf_distributed
+
+    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    L = np.asarray(potrf_distributed(_jnp(full), _grid, nb=_nb()))
+    out = L if uplo.lower().startswith("l") else L.conj().T
+    return out, _finite_info(out)
+
+
+def _pposv_distributed(dt, uplo, a, b):
+    from .parallel import posv_distributed
+
+    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    b = np.asarray(b, dtype=dt)
+    vec = b.ndim == 1
+    X = posv_distributed(_jnp(full), _jnp(b[:, None] if vec else b), _grid,
+                         nb=_nb())
+    X = np.asarray(X)
+    return (X[:, 0] if vec else X), _finite_info(X)
+
+
+def _pgetrf_distributed(dt, a):
+    from . import linalg as _la
+    from .parallel import getrf_distributed
+
+    LU, perm, info = getrf_distributed(_jnp(np.asarray(a, dtype=dt)), _grid,
+                                       nb=_nb())
+    return np.asarray(LU), _la.perm_to_pivots(perm), int(info)
+
+
+def _pgesv_distributed(dt, a, b):
+    from . import linalg as _la
+    from .parallel import getrf_distributed, getrs_distributed
+
+    b = np.asarray(b, dtype=dt)
+    vec = b.ndim == 1
+    LU, perm, info = getrf_distributed(_jnp(np.asarray(a, dtype=dt)), _grid,
+                                       nb=_nb())
+    X = getrs_distributed(LU, perm, _jnp(b[:, None] if vec else b), _grid)
+    X = np.asarray(X)
+    return (X[:, 0] if vec else X), _la.perm_to_pivots(perm), int(info)
+
+
+def _pgetrs_distributed(dt, trans, lu_, ipiv, b):
+    from . import linalg as _la
+    from .parallel import getrs_distributed
+
+    b = np.asarray(b, dtype=dt)
+    vec = b.ndim == 1
+    perm = _jnp(_la.pivots_to_perm(ipiv))
+    X = getrs_distributed(_jnp(np.asarray(lu_, dtype=dt)), perm,
+                          _jnp(b[:, None] if vec else b), _grid)
+    X = np.asarray(X)
+    return X[:, 0] if vec else X
+
+
+def _pgels_distributed(dt, trans, a, b):
+    from .parallel import gels_caqr_distributed
+
+    A = np.asarray(a, dtype=dt)
+    if trans.lower() in ("t", "c"):
+        A = A.conj().T
+    b = np.asarray(b, dtype=dt)
+    vec = b.ndim == 1
+    X = gels_caqr_distributed(_jnp(A), _jnp(b[:, None] if vec else b), _grid,
+                              nb=_nb())
+    X = np.asarray(X)
+    return X[:, 0] if vec else X
+
+
+def _ptrsm_distributed(dt, side, uplo, transa, diag, alpha, a, b):
+    from .parallel import trsm_distributed
+
+    A = np.asarray(a, dtype=dt)
+    B = np.asarray(b, dtype=dt)
+    lower = uplo.lower().startswith("l")
+    tri = np.tril(A) if lower else np.triu(A)
+    if diag.lower().startswith("u"):
+        np.fill_diagonal(tri, 1)
+    trans = transa.lower() in ("t", "c")
+    vec = B.ndim == 1
+    X = trsm_distributed(_jnp(tri), _jnp(B[:, None] if vec else B), _grid,
+                         lower=lower, conj_trans=trans)
+    X = alpha * np.asarray(X)
+    return X[:, 0] if vec else X
+
+
+# routines with a genuinely distributed implementation; everything else runs
+# through the shared single-device driver layer (documented fallback)
+_DISTRIBUTED = {
+    "gemm": _pgemm_distributed,
+    "potrf": _ppotrf_distributed,
+    "posv": _pposv_distributed,
+    "getrf": _pgetrf_distributed,
+    "gesv": _pgesv_distributed,
+    "getrs": _pgetrs_distributed,
+    "gels": _pgels_distributed,
+    "trsm": _ptrsm_distributed,
+}
+
+
+def _supports_distributed(name, args, kw) -> bool:
+    # side/trans/shape combinations without a mesh path fall back to the
+    # single-device driver layer
+    if name == "getrs":
+        return len(args) >= 1 and str(args[0]).lower().startswith("n")
+    if name == "trsm":
+        if len(args) < 7 or not str(args[0]).lower().startswith("l"):
+            return False
+        # plain transpose of a complex triangle has no mesh kernel (the
+        # distributed solve implements conjugate-transpose)
+        return not (str(args[2]).lower() == "t" and np.iscomplexobj(args[5]))
+    if name == "gels":
+        if len(args) < 2:
+            return False
+        a = np.asarray(args[1])
+        m, n = a.shape
+        if str(args[0]).lower() in ("t", "c"):
+            m, n = n, m
+        return m >= n
+    if name in ("getrf", "gesv"):
+        # the mesh LU kernel is square-only; rectangular falls back
+        if len(args) < 1:
+            return False
+        a = np.asarray(args[0])
+        return a.ndim == 2 and a.shape[0] == a.shape[1]
+    return True
 
 
 def _make(letter, name, lapack_fn):
     def fn(*args, **kw):
-        # distributed fast path for gemm on a real (>1 device) grid
-        if (name == "gemm" and _grid is not None and _HAVE_PARALLEL
-                and _grid.p * _grid.q > 1):
-            return _pgemm_distributed(_lapi._TYPES[letter], *args, **kw)
-        # other routines run through the shared driver layer; on a >1-device
-        # grid the factorizations shard via GSPMD inside the drivers
+        # distributed path on a real (>1 device) grid; single-device grids and
+        # unsupported variants run the shared driver layer
+        if (_grid is not None and _HAVE_PARALLEL and _grid.p * _grid.q > 1
+                and name in _DISTRIBUTED
+                and _supports_distributed(name, args, kw)):
+            return _DISTRIBUTED[name](_lapi._TYPES[letter], *args, **kw)
         return lapack_fn(*args, **kw)
 
     fn.__name__ = "p" + letter + name

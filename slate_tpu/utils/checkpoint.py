@@ -83,6 +83,6 @@ def load_matrix(path: str, p: Optional[int] = None, q: Optional[int] = None):
         M.set_array(jnp.asarray(data))
         return M
     uplo = Uplo.from_string(str(meta["uplo"]))
-    if "diag" in meta and tname == "TriangularMatrix":
+    if "diag" in meta and tname in ("TriangularMatrix", "TrapezoidMatrix"):
         kw["diag"] = str(meta["diag"])
     return cls.from_array(uplo, data, **kw)
