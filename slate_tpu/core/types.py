@@ -94,7 +94,7 @@ class Norm(_StrEnum):
 
 
 Norm._shorthand = {"1": Norm.One, "o": Norm.One, "2": Norm.Two, "i": Norm.Inf,
-                   "f": Norm.Fro, "m": Norm.Max}
+                   "f": Norm.Fro, "e": Norm.Fro, "m": Norm.Max}
 
 
 class NormScope(_StrEnum):

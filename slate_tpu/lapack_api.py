@@ -257,21 +257,28 @@ def _complete_basis(u: np.ndarray, full: int) -> np.ndarray:
     return q
 
 
-def _gesvd(dt, jobu, jobvt, a):
-    (a,) = _as(dt, a)
-    m, n = a.shape
-    k = min(m, n)
-    want_u = jobu.lower() != "n"
-    want_vt = jobvt.lower() != "n"
-    out = _la.svd(a, _opts(), want_u=want_u, want_vt=want_vt)
-    s = np.asarray(out[0])
-    u = np.asarray(out[1]) if want_u and out[1] is not None else None
-    vt = np.asarray(out[2]) if want_vt and len(out) > 2 and out[2] is not None else None
+def _svd_finish(s, u, vt, jobu, jobvt, m, n):
+    """Apply the LAPACK gesvd job semantics to raw SVD outputs — None-filter
+    by job flag and complete to a full basis for job 'a'.  Shared by the
+    single-device skin and the distributed scalapack route."""
+    u = np.asarray(u) if u is not None and jobu.lower() != "n" else None
+    vt = np.asarray(vt) if vt is not None and jobvt.lower() != "n" else None
     if u is not None and jobu.lower() == "a" and u.shape[1] < m:
         u = _complete_basis(u, m)        # LAPACK job 'a': full m x m U
     if vt is not None and jobvt.lower() == "a" and vt.shape[0] < n:
         vt = _complete_basis(vt.conj().T, n).conj().T
-    return s, u, vt
+    return np.asarray(s), u, vt
+
+
+def _gesvd(dt, jobu, jobvt, a):
+    (a,) = _as(dt, a)
+    m, n = a.shape
+    want_u = jobu.lower() != "n"
+    want_vt = jobvt.lower() != "n"
+    out = _la.svd(a, _opts(), want_u=want_u, want_vt=want_vt)
+    return _svd_finish(out[0], out[1] if want_u else None,
+                       out[2] if want_vt and len(out) > 2 else None,
+                       jobu, jobvt, m, n)
 
 
 # ---------------------------------------------------------------------------

@@ -21,3 +21,5 @@ from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
 from .lu_dist import (getrf_distributed, getrs_distributed, gesv_distributed)
 from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       geqrf_distributed, gels_caqr_distributed)
+from .eig_dist import (heev_distributed, svd_distributed, norm_distributed,
+                       col_norms_distributed)

@@ -1,0 +1,217 @@
+"""Distributed eigenvalue / SVD / norm drivers over the process grid.
+
+Reference analogues: ``src/heev.cc:68-225`` (the longest distributed pipeline:
+scale -> he2hb on the grid -> he2hbGather to rank 0 -> hb2st on rank 0 ->
+sterf/steqr/stedc -> redistribute -> back-transforms), ``src/svd.cc:99-141``
+(same shape via ge2tb/tb2bd/bdsqr), and the ``internal::norm`` reductions the
+``norm`` driver runs over distributed tiles.
+
+TPU re-design:
+
+* **Stage 1 is where the flops are** (O(n^2 nb) gemms per panel, O(n^3)
+  total) — it runs *sharded*: the blocked he2hb / ge2tb_band loops are jitted
+  with the operand placed on the (p, q) mesh and GSPMD partitions the
+  two-sided block-reflector gemms, inserting the panel all-gathers the
+  reference does with listBcast (SURVEY.md §5.8 mapping).
+* **Stage 2 is sequential by nature** (bulge chasing) and cheap (O(n^2 kd));
+  the band is *replicated* across the mesh — the exact analogue of
+  ``he2hbGather`` pulling the band to rank 0 (heev.cc:133-135) — and chased
+  locally, like the reference runs hb2st on rank 0 only (heev.cc:137-160).
+* **Back-transforms are gemms** and run sharded again (the reference
+  redistributes Z to 1-D for unmtr_hb2st then back, heev.cc:193-205; here the
+  resharding is one device_put).
+* Norms are one jitted masked reduction with sharded input — XLA lowers the
+  reduction to per-shard partials + a psum, which is ``internal::norm``'s
+  partial-tile reduction + MPI allreduce.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+
+
+def _replicated(grid: ProcessGrid) -> NamedSharding:
+    return NamedSharding(grid.mesh, P(None, None))
+
+
+@lru_cache(maxsize=32)
+def _constrain_fn(mesh, row_shard: bool, col_shard: bool):
+    spec = NamedSharding(mesh, P(ROW_AXIS if row_shard else None,
+                                 COL_AXIS if col_shard else None))
+    return jax.jit(lambda a: lax.with_sharding_constraint(a, spec))
+
+
+def _shard(x, grid: ProcessGrid, row: bool = True, col: bool = True):
+    """Place x block-sharded on the grid via a sharding constraint —
+    unlike device_put this tolerates non-divisible shapes (GSPMD pads)."""
+    return _constrain_fn(grid.mesh, row, col)(x)
+
+
+@lru_cache(maxsize=32)
+def _he2hb_dist_fn(mesh, n: int, nb: int, dtype_str: str):
+    from ..linalg.eig import he2hb
+
+    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def fn(Af):
+        Af = lax.with_sharding_constraint(Af, spec)
+        return he2hb(Af, nb=nb)
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=32)
+def _ge2tb_dist_fn(mesh, m: int, n: int, nb: int, dtype_str: str):
+    from ..linalg.svd import ge2tb_band
+
+    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def fn(Af):
+        Af = lax.with_sharding_constraint(Af, spec)
+        return ge2tb_band(Af, nb=nb)
+
+    return jax.jit(fn)
+
+
+def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
+                     want_vectors: bool = True, method_eig: str = "qr"):
+    """Distributed Hermitian eigensolve over the (p, q) mesh (src/heev.cc).
+
+    Returns (ascending eigenvalues, Z or None); Z comes back sharded on the
+    grid.  ``method_eig='dc'`` solves the tridiagonal with stedc.
+    """
+    from ..linalg.eig import _safe_scale, hb2st, sterf, unmtr_he2hb
+    from ..linalg.stedc import stedc as _stedc
+    from ..linalg.eig import steqr
+
+    n = A.shape[-1]
+    nb = max(2, min(nb, max(2, n // 2)))
+    a, factor = _safe_scale(A)
+    a = _shard(a, grid)
+    # stage 1 on the mesh: GSPMD shards the two-sided panel gemms
+    band, Vs, Ts = _he2hb_dist_fn(grid.mesh, n, nb, str(a.dtype))(a)
+    # he2hbGather analogue: replicate the (cheap) band for the local chase
+    band = jax.device_put(band, _replicated(grid))
+    out = hb2st(band, kd=nb, want_vectors=want_vectors)
+    if not want_vectors:
+        d, e = out
+        # values-only always takes sterf — D&C inherently carries vectors
+        # (merge z-couplings ARE eigenvector rows), exactly why the reference
+        # routes no-vector solves to sterf too (heev.cc:208-215)
+        lam = sterf(d, e)
+        return lam * factor, None
+    d, e, Q2 = out
+    lam, Zt = (_stedc if method_eig == "dc" else steqr)(d, e)
+    Z = jnp.matmul(Q2, Zt.astype(Q2.dtype), precision=lax.Precision.HIGHEST)
+    # redistribute + stage-1 back-transform (sharded gemms)
+    Z = _shard(Z, grid)
+    Z = unmtr_he2hb("left", "n", Vs, Ts, Z)
+    return lam * factor, Z
+
+
+def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
+                    want_vectors: bool = True):
+    """Distributed SVD over the (p, q) mesh (src/svd.cc pipeline).
+
+    Returns (S descending, U or None, VT or None); U/VT come back sharded.
+    Wide inputs run on the conjugate transpose (U/VT swap), like the
+    reference's LQ pre-step (svd.cc:224+).
+    """
+    from ..linalg.eig import _safe_scale
+    from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd, unmbr_ge2tb_factors
+
+    m, n = A.shape[-2:]
+    if m < n:
+        S, V, UT = svd_distributed(jnp.conj(A).T, grid, nb=nb,
+                                   want_vectors=want_vectors)
+        if not want_vectors:
+            return S, None, None
+        return S, jnp.conj(UT).T, jnp.conj(V).T
+    k = n
+    nb = max(2, min(nb, max(2, k - 1)))
+    a, factor = _safe_scale(A)
+    a = _shard(a, grid)
+    band, Uf, Vf = _ge2tb_dist_fn(grid.mesh, m, n, nb, str(a.dtype))(a)
+    band = jax.device_put(band, _replicated(grid))
+    sq = band[:k, :k]
+    if k > 2:
+        out = tb2bd(sq, nb, want_vectors=want_vectors)
+        d, e = out[0], out[1]
+        U2, VT2 = (out[2], out[3]) if want_vectors else (None, None)
+    else:
+        d_c = jnp.diagonal(sq)
+        e_c = jnp.diagonal(sq, offset=1)
+        pu, pw = _bidiag_phases(d_c, e_c, a.dtype)
+        d, e = jnp.abs(d_c), jnp.abs(e_c)
+        U2, VT2 = jnp.diag(pu), jnp.conj(jnp.diag(pw)).T
+    S, Ub, VTb = bdsqr(d, e, want_vectors=want_vectors)
+    if not want_vectors:
+        return S * factor, None, None
+    # U = Q_u [U2 Ub; 0],  VT = (VTb VT2) Q_v^H — sharded block-reflector gemms
+    Uin = jnp.zeros((m, k), a.dtype).at[:k, :k].set(
+        jnp.matmul(U2, Ub.astype(U2.dtype), precision=lax.Precision.HIGHEST))
+    U = unmbr_ge2tb_factors("left", "n", Uf, _shard(Uin, grid))
+    Vin = jnp.conj(jnp.matmul(VTb.astype(VT2.dtype), VT2,
+                              precision=lax.Precision.HIGHEST)).T
+    Vfull = unmbr_ge2tb_factors("left", "n", Vf,
+                                _shard(Vin, grid, col=False))
+    return S * factor, U, jnp.conj(Vfull).T
+
+
+@lru_cache(maxsize=64)
+def _norm_dist_fn(mesh, kind: str, uplo: str, dtype_str: str):
+    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def fn(a):
+        x = lax.with_sharding_constraint(a, spec)
+        if uplo == "lower":
+            x = jnp.tril(x)
+        elif uplo == "upper":
+            x = jnp.triu(x)
+        ax = jnp.abs(x)
+        if kind == "max":
+            return jnp.max(ax)
+        if kind == "one":
+            return jnp.max(jnp.sum(ax, axis=-2))
+        if kind == "inf":
+            return jnp.max(jnp.sum(ax, axis=-1))
+        # fro
+        return jnp.sqrt(jnp.sum(ax * ax))
+
+    return jax.jit(fn)
+
+
+def norm_distributed(kind, A: jax.Array, grid: ProcessGrid,
+                     uplo: str = "general"):
+    """Distributed matrix norm (src/norm.cc over internal::genorm partials +
+    MPI allreduce; here one sharded masked reduction — XLA emits the per-shard
+    partials and the psum).  kind: max | one | inf | fro."""
+    from ..core.types import Norm
+
+    k = Norm.from_string(kind) if not isinstance(kind, Norm) else kind
+    name = {Norm.Max: "max", Norm.One: "one", Norm.Inf: "inf",
+            Norm.Fro: "fro"}[k]
+    return _norm_dist_fn(grid.mesh, name, uplo, str(jnp.asarray(A).dtype))(A)
+
+
+@lru_cache(maxsize=8)
+def _col_norms_fn(mesh):
+    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def fn(a):
+        a = lax.with_sharding_constraint(a, spec)
+        return jnp.max(jnp.abs(a), axis=-2)
+
+    return jax.jit(fn)
+
+
+def col_norms_distributed(A: jax.Array, grid: ProcessGrid) -> jax.Array:
+    """Distributed column max-norms (internal::colNorms analogue)."""
+    return _col_norms_fn(grid.mesh)(A)

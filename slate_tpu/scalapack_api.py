@@ -212,6 +212,46 @@ def _ptrsm_distributed(dt, side, uplo, transa, diag, alpha, a, b):
     return X[:, 0] if vec else X
 
 
+def _pheev_distributed(dt, jobz, uplo, a, *, sy=False):
+    from .parallel import heev_distributed
+
+    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    want = jobz.lower() == "v"
+    lam, z = heev_distributed(_jnp(full), _grid, nb=_nb(), want_vectors=want)
+    return np.asarray(lam), (np.asarray(z) if want else None)
+
+
+def _pgesvd_distributed(dt, jobu, jobvt, a):
+    from .parallel import svd_distributed
+
+    a = np.asarray(a, dtype=dt)
+    want = jobu.lower() != "n" or jobvt.lower() != "n"
+    S, U, VT = svd_distributed(_jnp(a), _grid, nb=_nb(), want_vectors=want)
+    return _lapi._svd_finish(S, U, VT, jobu, jobvt, *a.shape)
+
+
+def _plange_distributed(dt, norm, a):
+    from .parallel import norm_distributed
+
+    return float(norm_distributed(_norm_kind(norm),
+                                  _jnp(np.asarray(a, dtype=dt)), _grid))
+
+
+def _planhe_distributed(dt, norm, uplo, a, *, sy=False):
+    from .parallel import norm_distributed
+
+    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    return float(norm_distributed(_norm_kind(norm), _jnp(full), _grid))
+
+
+def _norm_kind(norm):
+    """Resolve a LAPACK norm character through the shared Norm enum — unknown
+    characters raise exactly like the single-device fallback path."""
+    from .core.types import Norm
+
+    return Norm.from_string(str(norm).lower()[0])
+
+
 # routines with a genuinely distributed implementation; everything else runs
 # through the shared single-device driver layer (documented fallback)
 _DISTRIBUTED = {
@@ -223,6 +263,14 @@ _DISTRIBUTED = {
     "getrs": _pgetrs_distributed,
     "gels": _pgels_distributed,
     "trsm": _ptrsm_distributed,
+    "heev": _pheev_distributed,
+    "heevd": _pheev_distributed,
+    "syev": _pheev_distributed,
+    "syevd": _pheev_distributed,
+    "gesvd": _pgesvd_distributed,
+    "lange": _plange_distributed,
+    "lanhe": _planhe_distributed,
+    "lansy": _planhe_distributed,
 }
 
 

@@ -1,0 +1,22 @@
+"""Two-process jax.distributed CPU tier (reference CI runs mpirun -np 4,
+.github/workflows/test.sh:48 — same SPMD path, real process boundary)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "tools", "run_multiprocess.py")
+
+
+@pytest.mark.timeout(600)
+def test_two_process_distributed_tier():
+    env = dict(os.environ)
+    # workers self-configure (cpu platform, 4 virtual devices each)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, _SCRIPT], capture_output=True,
+                          text=True, timeout=580, env=env)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "MULTIPROCESS PASS" in proc.stdout
