@@ -1,0 +1,401 @@
+// slate_tpu C API implementation.
+//
+// Reference analogue: src/c_api/wrappers.cc — the reference mirrors its C++
+// classes into C structs; here the compute path is the JAX runtime, so the C
+// ABI embeds a Python interpreter once per process and forwards each entry
+// point to the same scalapack-skin drivers the Python API uses (they in turn
+// dispatch to the distributed mesh implementations when a grid is active —
+// slate_gridinit maps to scalapack_api.gridinit).
+//
+// Buffers cross the boundary zero-copy: each C pointer is wrapped as a
+// writable memoryview, reshaped column-major (LAPACK convention) on the
+// Python side, and results are written back through the view.  Works both
+// embedded in a C program (Py_Initialize path) and loaded into an existing
+// Python process (ctypes path; PyGILState handles the interpreter).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+PyObject* g_globals = nullptr;
+bool g_we_initialized = false;
+
+const char* kPrelude =
+    "import sys, os\n"
+    "_root = os.environ.get('SLATE_TPU_ROOT')\n"
+    "if _root and _root not in sys.path:\n"
+    "    sys.path.insert(0, _root)\n"
+    "import jax\n"
+    "jax.config.update('jax_enable_x64', True)\n"  // d/z routines need f64
+    "import numpy as np\n"
+    "import slate_tpu\n"
+    "import slate_tpu.scalapack_api as sk\n";
+
+int ensure_init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL so every entry point can use Ensure/Release uniformly
+    PyEval_SaveThread();
+  }
+  if (g_globals != nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  g_globals = PyDict_New();
+  PyDict_SetItemString(g_globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r = PyRun_String(kPrelude, Py_file_input, g_globals, g_globals);
+  int rc = 0;
+  if (r == nullptr) {
+    PyErr_Print();
+    Py_CLEAR(g_globals);
+    rc = -999;
+  } else {
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void set_mem(PyObject* locals, const char* name, void* ptr, Py_ssize_t bytes) {
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(ptr), bytes, PyBUF_WRITE);
+  PyDict_SetItemString(locals, name, mv);
+  Py_DECREF(mv);
+}
+
+void set_int(PyObject* locals, const char* name, int64_t v) {
+  PyObject* o = PyLong_FromLongLong(v);
+  PyDict_SetItemString(locals, name, o);
+  Py_DECREF(o);
+}
+
+void set_dbl(PyObject* locals, const char* name, double v) {
+  PyObject* o = PyFloat_FromDouble(v);
+  PyDict_SetItemString(locals, name, o);
+  Py_DECREF(o);
+}
+
+void set_chr(PyObject* locals, const char* name, char c) {
+  char buf[2] = {c, 0};
+  PyObject* o = PyUnicode_FromString(buf);
+  PyDict_SetItemString(locals, name, o);
+  Py_DECREF(o);
+}
+
+// Run `code` with `locals`; returns locals["info"] (0 when unset), or -998 on
+// a Python exception (printed to stderr).
+int run_code(const char* code, PyObject* locals) {
+  PyObject* r = PyRun_String(code, Py_file_input, g_globals, locals);
+  if (r == nullptr) {
+    PyErr_Print();
+    return -998;
+  }
+  Py_DECREF(r);
+  PyObject* info = PyDict_GetItemString(locals, "info");
+  return info != nullptr ? static_cast<int>(PyLong_AsLong(info)) : 0;
+}
+
+double run_code_dbl(const char* code, PyObject* locals, const char* out) {
+  PyObject* r = PyRun_String(code, Py_file_input, g_globals, locals);
+  if (r == nullptr) {
+    PyErr_Print();
+    return -1.0;
+  }
+  Py_DECREF(r);
+  PyObject* v = PyDict_GetItemString(locals, out);
+  return v != nullptr ? PyFloat_AsDouble(v) : -1.0;
+}
+
+struct Call {
+  PyGILState_STATE gil;
+  PyObject* locals;
+  bool ok;
+  Call() : ok(false) {
+    if (ensure_init() != 0) return;
+    gil = PyGILState_Ensure();
+    locals = PyDict_New();
+    ok = true;
+  }
+  ~Call() {
+    if (ok) {
+      Py_DECREF(locals);
+      PyGILState_Release(gil);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int slate_init(void) { return ensure_init(); }
+
+void slate_finalize(void) {
+  if (g_we_initialized && Py_IsInitialized()) {
+    PyGILState_Ensure();
+    Py_CLEAR(g_globals);
+    Py_Finalize();
+    g_we_initialized = false;
+  }
+}
+
+const char* slate_version(void) { return "slate_tpu-c-api 2.0"; }
+
+int slate_gridinit(int p, int q) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "p", p);
+  set_int(c.locals, "q", q);
+  return run_code(
+      "try:\n"
+      "    sk.gridinit(int(p), int(q)); info = 0\n"
+      "except Exception as e:\n"
+      "    import sys; print(e, file=sys.stderr); info = 1\n",
+      c.locals);
+}
+
+void slate_gridexit(void) {
+  Call c;
+  if (!c.ok) return;
+  run_code("sk.gridexit()\ninfo = 0\n", c.locals);
+}
+
+// ---------------------------------------------------------------------------
+
+static int gemm_impl(const char* pyname, char transa, char transb, int64_t m,
+                     int64_t n, int64_t k, double alpha, const void* A,
+                     int64_t lda, const void* B, int64_t ldb, double beta,
+                     void* C, int64_t ldc, int64_t esz, const char* npdt) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t acols = (transa == 'n' || transa == 'N') ? k : m;
+  int64_t bcols = (transb == 'n' || transb == 'N') ? n : k;
+  set_mem(c.locals, "Abuf", const_cast<void*>(A), lda * acols * esz);
+  set_mem(c.locals, "Bbuf", const_cast<void*>(B), ldb * bcols * esz);
+  set_mem(c.locals, "Cbuf", C, ldc * n * esz);
+  set_chr(c.locals, "ta", transa);
+  set_chr(c.locals, "tb", transb);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "k", k);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_int(c.locals, "ldc", ldc);
+  set_dbl(c.locals, "alpha", alpha);
+  set_dbl(c.locals, "beta", beta);
+  set_chr(c.locals, "dtc", npdt[0]);
+  PyDict_SetItemString(c.locals, "fn",
+                       PyDict_GetItemString(g_globals, "sk"));
+  char code[1024];
+  snprintf(code, sizeof(code),
+           "dt = np.float64 if dtc == 'd' else np.float32\n"
+           "ar = (m, k) if ta.lower() == 'n' else (k, m)\n"
+           "br = (k, n) if tb.lower() == 'n' else (n, k)\n"
+           "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:ar[0], :ar[1]]\n"
+           "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:br[0], :br[1]]\n"
+           "cm = np.frombuffer(Cbuf, dt).reshape((ldc, -1), order='F')[:m, :n]\n"
+           "out = sk.%s(ta, tb, alpha, a, b, beta, cm)\n"
+           "cm[...] = out\n"
+           "info = 0\n",
+           pyname);
+  return run_code(code, c.locals);
+}
+
+int slate_dgemm(char transa, char transb, int64_t m, int64_t n, int64_t k,
+                double alpha, const double* A, int64_t lda, const double* B,
+                int64_t ldb, double beta, double* C, int64_t ldc) {
+  return gemm_impl("pdgemm", transa, transb, m, n, k, alpha, A, lda, B, ldb,
+                   beta, C, ldc, 8, "d");
+}
+
+int slate_sgemm(char transa, char transb, int64_t m, int64_t n, int64_t k,
+                float alpha, const float* A, int64_t lda, const float* B,
+                int64_t ldb, float beta, float* C, int64_t ldc) {
+  return gemm_impl("psgemm", transa, transb, m, n, k, alpha, A, lda, B, ldb,
+                   beta, C, ldc, 4, "s");
+}
+
+// ---------------------------------------------------------------------------
+
+static int gesv_impl(const char* pre, int64_t n, int64_t nrhs, void* A,
+                     int64_t lda, int64_t* ipiv, void* B, int64_t ldb,
+                     int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * esz);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_mem(c.locals, "Pbuf", ipiv, n * 8);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", pre[0]);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "pv = np.frombuffer(Pbuf, np.int64)[:n]\n"
+      "fac = sk.pdgetrf if dtc == 'd' else sk.psgetrf\n"
+      "slv = sk.pdgetrs if dtc == 'd' else sk.psgetrs\n"
+      "lu, piv, info = fac(a.copy())\n"
+      "a[...] = lu\n"
+      "pv[...] = np.asarray(piv, np.int64)\n"
+      "if info == 0:\n"
+      "    b[...] = slv('n', lu, piv, b.copy())\n",
+      c.locals);
+}
+
+int slate_dgesv(int64_t n, int64_t nrhs, double* A, int64_t lda, int64_t* ipiv,
+                double* B, int64_t ldb) {
+  return gesv_impl("d", n, nrhs, A, lda, ipiv, B, ldb, 8);
+}
+
+int slate_sgesv(int64_t n, int64_t nrhs, float* A, int64_t lda, int64_t* ipiv,
+                float* B, int64_t ldb) {
+  return gesv_impl("s", n, nrhs, A, lda, ipiv, B, ldb, 4);
+}
+
+// ---------------------------------------------------------------------------
+
+static int posv_impl(const char* pre, char uplo, int64_t n, int64_t nrhs,
+                     void* A, int64_t lda, void* B, int64_t ldb, int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * esz);
+  if (B != nullptr)
+    set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", pre[0]);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
+      "fac = sk.pdpotrf if dtc == 'd' else sk.pspotrf\n"
+      "slv = sk.pdpotrs if dtc == 'd' else sk.pspotrs\n"
+      "Lf, info = fac(uplo, a.copy())\n"
+      "mask = np.tril(np.ones((n, n), bool)) if uplo.lower().startswith('l') "
+      "else np.triu(np.ones((n, n), bool))\n"
+      "a[mask] = np.asarray(Lf, dt)[mask]\n"
+      "if info == 0 and 'Bbuf' in dir():\n"
+      "    b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "    b[...] = slv(uplo, np.asarray(Lf, dt), b.copy())\n",
+      c.locals);
+}
+
+int slate_dposv(char uplo, int64_t n, int64_t nrhs, double* A, int64_t lda,
+                double* B, int64_t ldb) {
+  return posv_impl("d", uplo, n, nrhs, A, lda, B, ldb, 8);
+}
+
+int slate_sposv(char uplo, int64_t n, int64_t nrhs, float* A, int64_t lda,
+                float* B, int64_t ldb) {
+  return posv_impl("s", uplo, n, nrhs, A, lda, B, ldb, 4);
+}
+
+int slate_dpotrf(char uplo, int64_t n, double* A, int64_t lda) {
+  return posv_impl("d", uplo, n, 0, A, lda, nullptr, 1, 8);
+}
+
+int slate_spotrf(char uplo, int64_t n, float* A, int64_t lda) {
+  return posv_impl("s", uplo, n, 0, A, lda, nullptr, 1, 4);
+}
+
+// ---------------------------------------------------------------------------
+
+int slate_dgels(char trans, int64_t m, int64_t n, int64_t nrhs, double* A,
+                int64_t lda, double* B, int64_t ldb) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * 8);
+  set_chr(c.locals, "trans", trans);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  return run_code(
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:m, :n]\n"
+      "b = np.frombuffer(Bbuf, np.float64).reshape((ldb, -1), order='F')\n"
+      "x = sk.pdgels(trans, a.copy(), b[:m, :nrhs].copy())\n"
+      "b[:x.shape[0], :nrhs] = x\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_dsyev(char jobz, char uplo, int64_t n, double* A, int64_t lda,
+                double* W) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Wbuf", W, n * 8);
+  set_chr(c.locals, "jobz", jobz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  return run_code(
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:n, :n]\n"
+      "w = np.frombuffer(Wbuf, np.float64)[:n]\n"
+      "lam, z = sk.pdsyev(jobz, uplo, a.copy())\n"
+      "w[...] = np.asarray(lam, np.float64)\n"
+      "if jobz.lower() == 'v' and z is not None:\n"
+      "    a[...] = np.asarray(z, np.float64)\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_dgesvd(char jobu, char jobvt, int64_t m, int64_t n, double* A,
+                 int64_t lda, double* S, double* U, int64_t ldu, double* VT,
+                 int64_t ldvt) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t kmin = m < n ? m : n;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Sbuf", S, kmin * 8);
+  if (U != nullptr) set_mem(c.locals, "Ubuf", U, ldu * kmin * 8);
+  if (VT != nullptr) set_mem(c.locals, "Vbuf", VT, ldvt * n * 8);
+  set_chr(c.locals, "jobu", jobu);
+  set_chr(c.locals, "jobvt", jobvt);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldu", ldu);
+  set_int(c.locals, "ldvt", ldvt);
+  return run_code(
+      "k = min(m, n)\n"
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:m, :n]\n"
+      "s, u, vt = sk.pdgesvd(jobu, jobvt, a.copy())\n"
+      "np.frombuffer(Sbuf, np.float64)[:k] = np.asarray(s)[:k]\n"
+      "if u is not None and 'Ubuf' in dir():\n"
+      "    um = np.frombuffer(Ubuf, np.float64).reshape((ldu, -1), order='F')\n"
+      "    um[:m, :u.shape[1]] = u\n"
+      "if vt is not None and 'Vbuf' in dir():\n"
+      "    vm = np.frombuffer(Vbuf, np.float64).reshape((ldvt, -1), order='F')\n"
+      "    vm[:vt.shape[0], :n] = vt\n"
+      "info = 0\n",
+      c.locals);
+}
+
+double slate_dlange(char norm, int64_t m, int64_t n, const double* A,
+                    int64_t lda) {
+  Call c;
+  if (!c.ok) return -1.0;
+  set_mem(c.locals, "Abuf", const_cast<double*>(A), lda * n * 8);
+  set_chr(c.locals, "norm", norm);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  return run_code_dbl(
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:m, :n]\n"
+      "val = float(sk.pdlange(norm, a))\n",
+      c.locals, "val");
+}
+
+}  // extern "C"

@@ -37,10 +37,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
 
 
-def _replicated(grid: ProcessGrid) -> NamedSharding:
-    return NamedSharding(grid.mesh, P(None, None))
-
-
 @lru_cache(maxsize=32)
 def _constrain_fn(mesh, row_shard: bool, col_shard: bool):
     spec = NamedSharding(mesh, P(ROW_AXIS if row_shard else None,
@@ -92,13 +88,19 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     from ..linalg.eig import steqr
 
     n = A.shape[-1]
+    if n < 8:
+        # no meaningful band structure below one panel — local fused solve
+        # (the single-device heev makes the same switch)
+        lam, z = (jnp.linalg.eigh(A) if want_vectors
+                  else (jnp.linalg.eigvalsh(A), None))
+        return lam, z
     nb = max(2, min(nb, max(2, n // 2)))
     a, factor = _safe_scale(A)
     a = _shard(a, grid)
     # stage 1 on the mesh: GSPMD shards the two-sided panel gemms
     band, Vs, Ts = _he2hb_dist_fn(grid.mesh, n, nb, str(a.dtype))(a)
     # he2hbGather analogue: replicate the (cheap) band for the local chase
-    band = jax.device_put(band, _replicated(grid))
+    band = jax.device_put(band, grid.replicated())
     out = hb2st(band, kd=nb, want_vectors=want_vectors)
     if not want_vectors:
         d, e = out
@@ -128,6 +130,13 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd, unmbr_ge2tb_factors
 
     m, n = A.shape[-2:]
+    if min(m, n) < 8:
+        out = jnp.linalg.svd(A, full_matrices=False) if want_vectors else \
+            (jnp.linalg.svd(A, compute_uv=False), None, None)
+        if want_vectors:
+            U, S, VT = out[0], out[1], out[2]
+            return S, U, VT
+        return out[0], None, None
     if m < n:
         S, V, UT = svd_distributed(jnp.conj(A).T, grid, nb=nb,
                                    want_vectors=want_vectors)
@@ -139,7 +148,7 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     a, factor = _safe_scale(A)
     a = _shard(a, grid)
     band, Uf, Vf = _ge2tb_dist_fn(grid.mesh, m, n, nb, str(a.dtype))(a)
-    band = jax.device_put(band, _replicated(grid))
+    band = jax.device_put(band, grid.replicated())
     sq = band[:k, :k]
     if k > 2:
         out = tb2bd(sq, nb, want_vectors=want_vectors)

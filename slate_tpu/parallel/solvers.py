@@ -66,6 +66,55 @@ def _potrf_dist_fn(mesh, n: int, nb: int, dtype_str: str):
     return jax.jit(fn, in_shardings=spec, out_shardings=spec)
 
 
+# above this many panels the unrolled factorization's HLO gets expensive to
+# compile (tens of seconds); the fori_loop body below keeps program size O(1)
+_POTRF_UNROLL_MAX_NT = 32
+
+
+@lru_cache(maxsize=32)
+def _potrf_dist_loop_fn(mesh, n: int, nb: int, dtype_str: str):
+    """O(1)-program-size distributed Cholesky: a lax.fori_loop whose body
+    factors one panel with masked full-height operations.
+
+    The reference's loop is O(nt) work but O(1) program (potrf.cc:84-195);
+    the unrolled fn above is O(nt) program.  This body trades that for masked
+    full-width updates (~3x the flops of the sliced trailing update — the
+    rank-nb product runs over all n columns and the mask discards the left
+    ones), which XLA still runs as dense MXU gemms; at large nt the compile
+    saving dominates.
+    """
+    spec = jax.NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+    nt = -(-n // nb)
+
+    def body(k, L):
+        k0 = k * nb
+        rows = jnp.arange(n)
+        Dkk = lax.dynamic_slice(L, (k0, k0), (nb, nb))
+        Lkk = lax.linalg.cholesky(Dkk)
+        L = lax.dynamic_update_slice(L, Lkk, (k0, k0))
+        # full-height panel solve; rows above the diagonal block are masked out
+        P_ = lax.dynamic_slice(L, (0, k0), (n, nb))
+        P_ = jnp.where((rows >= k0 + nb)[:, None], P_, 0)
+        panel = lax.linalg.triangular_solve(
+            Lkk, P_, left_side=False, lower=True,
+            conjugate_a=True, transpose_a=True)
+        L = lax.dynamic_update_slice(
+            L, jnp.where((rows >= k0 + nb)[:, None], panel,
+                         lax.dynamic_slice(L, (0, k0), (n, nb))), (0, k0))
+        # masked trailing update over the full matrix (cols >= k0+nb only)
+        upd = jnp.matmul(panel, jnp.conj(panel.T),
+                         precision=lax.Precision.HIGHEST)
+        mask = (rows >= k0 + nb)[None, :]
+        L = L - jnp.where(mask, upd, 0)
+        return lax.with_sharding_constraint(L, spec)
+
+    def fn(Af):
+        L = lax.fori_loop(0, nt, body, Af)
+        return jnp.tril(L)
+
+    return jax.jit(fn, in_shardings=spec, out_shardings=spec)
+
+
 from .distribute import lcm as _lcm
 
 
@@ -82,12 +131,28 @@ def _pad_spd(Af: jax.Array, mult: int):
     return Af2.at[idx, idx].set(1), n
 
 
-def potrf_distributed(Af: jax.Array, grid: ProcessGrid, nb: int = 256) -> jax.Array:
-    """Distributed lower Cholesky of a full Hermitian array. Returns sharded L."""
-    Af, n = _pad_spd(Af, _lcm(grid.p, grid.q))
+def potrf_distributed(Af: jax.Array, grid: ProcessGrid, nb: int = 256,
+                      method: str = "auto") -> jax.Array:
+    """Distributed lower Cholesky of a full Hermitian array. Returns sharded L.
+
+    method: "unroll" (O(nt) program, optimal flops), "loop" (O(1) program,
+    masked updates — survives large panel counts), or "auto" which switches to
+    the loop body past _POTRF_UNROLL_MAX_NT panels (the BASELINE n=16384
+    nb=256 configuration is 64 panels, where unrolled compiles cost minutes).
+    """
+    n0 = Af.shape[-1]
+    nb = max(1, min(nb, n0))
+    unit = _lcm(grid.p, grid.q)
+    use_loop = method == "loop" or (
+        method == "auto" and -(-n0 // nb) > _POTRF_UNROLL_MAX_NT)
+    if use_loop:
+        import math
+        unit = unit * nb // math.gcd(unit, nb)  # the loop body needs nb | npad
+    Af, n = _pad_spd(Af, unit)
     npad = Af.shape[-1]
     Af = jax.device_put(Af, grid.spec())
-    L = _potrf_dist_fn(grid.mesh, npad, min(nb, npad), str(Af.dtype))(Af)
+    make = _potrf_dist_loop_fn if use_loop else _potrf_dist_fn
+    L = make(grid.mesh, npad, min(nb, npad), str(Af.dtype))(Af)
     return L[:n, :n] if npad != n else L
 
 

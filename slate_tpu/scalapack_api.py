@@ -212,7 +212,7 @@ def _ptrsm_distributed(dt, side, uplo, transa, diag, alpha, a, b):
     return X[:, 0] if vec else X
 
 
-def _pheev_distributed(dt, jobz, uplo, a, *, sy=False):
+def _pheev_distributed(dt, jobz, uplo, a):
     from .parallel import heev_distributed
 
     full = _sym_full(uplo, np.asarray(a, dtype=dt))
@@ -237,7 +237,7 @@ def _plange_distributed(dt, norm, a):
                                   _jnp(np.asarray(a, dtype=dt)), _grid))
 
 
-def _planhe_distributed(dt, norm, uplo, a, *, sy=False):
+def _planhe_distributed(dt, norm, uplo, a):
     from .parallel import norm_distributed
 
     full = _sym_full(uplo, np.asarray(a, dtype=dt))

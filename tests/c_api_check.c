@@ -1,0 +1,183 @@
+/* C API conformance check (reference unit_test/test_c_api.cc): drives every
+ * exported family through the embedded runtime and verifies residuals.
+ * Compiled + run by tests/test_c_api.py. */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "slate_tpu.h"
+
+static double frand(void) { return (double)rand() / RAND_MAX - 0.5; }
+
+static int check(const char* name, double resid, double tol) {
+  printf("%-10s %.3e %s\n", name, resid, resid <= tol ? "ok" : "FAIL");
+  return resid <= tol ? 0 : 1;
+}
+
+int main(void) {
+  int fails = 0;
+  srand(11);
+  const int64_t n = 20, m = 32, nrhs = 3;
+
+  /* gemm: C = 2 A B - C */
+  {
+    double *A = malloc(m * n * 8), *B = malloc(n * m * 8);
+    double *C = malloc(m * m * 8), *R = malloc(m * m * 8);
+    for (int64_t i = 0; i < m * n; ++i) A[i] = frand();
+    for (int64_t i = 0; i < n * m; ++i) B[i] = frand();
+    for (int64_t i = 0; i < m * m; ++i) R[i] = C[i] = frand();
+    slate_dgemm('n', 'n', m, m, n, 2.0, A, m, B, n, -1.0, C, m);
+    double maxe = 0;
+    for (int64_t j = 0; j < m; ++j)
+      for (int64_t i = 0; i < m; ++i) {
+        double acc = -R[i + j * m];
+        for (int64_t k = 0; k < n; ++k) acc += 2.0 * A[i + k * m] * B[k + j * n];
+        double d = fabs(acc - C[i + j * m]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dgemm", maxe, 1e-12);
+    free(A); free(B); free(C); free(R);
+  }
+
+  /* posv + potrf */
+  {
+    double *A = malloc(n * n * 8), *S = malloc(n * n * 8), *B = malloc(n * nrhs * 8);
+    double *Bs = malloc(n * nrhs * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i) A[i + j * n] = frand();
+    /* SPD: S = A A^T + n I */
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = (i == j) ? (double)n : 0.0;
+        for (int64_t k = 0; k < n; ++k) acc += A[i + k * n] * A[j + k * n];
+        S[i + j * n] = acc;
+      }
+    double* Ssave = malloc(n * n * 8);
+    for (int64_t i = 0; i < n * n; ++i) Ssave[i] = S[i];
+    for (int64_t i = 0; i < n * nrhs; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dposv('l', n, nrhs, S, n, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = 0;
+        for (int64_t k = 0; k < n; ++k) acc += Ssave[i + k * n] * B[k + j * n];
+        double d = fabs(acc - Bs[i + j * n]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dposv", maxe, 1e-9);
+    /* potrf alone: L L^T == S */
+    for (int64_t i = 0; i < n * n; ++i) S[i] = Ssave[i];
+    info = slate_dpotrf('l', n, S, n);
+    maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = j; i < n; ++i) {
+        double acc = 0;
+        for (int64_t k = 0; k <= (i < j ? i : j); ++k)
+          acc += S[i + k * n] * S[j + k * n];
+        double d = fabs(acc - Ssave[i + j * n]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dpotrf", maxe, 1e-9);
+    free(A); free(S); free(Ssave); free(B); free(Bs);
+  }
+
+  /* gels (tall) */
+  {
+    double *A = malloc(m * n * 8), *As = malloc(m * n * 8);
+    double *B = malloc(m * nrhs * 8), *Bs = malloc(m * nrhs * 8);
+    for (int64_t i = 0; i < m * n; ++i) As[i] = A[i] = frand();
+    for (int64_t i = 0; i < m * nrhs; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dgels('n', m, n, nrhs, A, m, B, m);
+    /* normal equations residual: A^T (A X - B) ~ 0 */
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t c = 0; c < n; ++c) {
+        double acc = 0;
+        for (int64_t i = 0; i < m; ++i) {
+          double ax = 0;
+          for (int64_t k = 0; k < n; ++k) ax += As[i + k * m] * B[k + j * m];
+          acc += As[i + c * m] * (ax - Bs[i + j * m]);
+        }
+        if (fabs(acc) > maxe) maxe = fabs(acc);
+      }
+    fails += check("dgels", maxe, 1e-8);
+    free(A); free(As); free(B); free(Bs);
+  }
+
+  /* syev */
+  {
+    double *A = malloc(n * n * 8), *As = malloc(n * n * 8), *W = malloc(n * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i <= j; ++i) {
+        double v = frand();
+        A[i + j * n] = A[j + i * n] = v;
+      }
+    for (int64_t i = 0; i < n * n; ++i) As[i] = A[i];
+    int info = slate_dsyev('v', 'l', n, A, n, W);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = 0;
+        for (int64_t k = 0; k < n; ++k) acc += As[i + k * n] * A[k + j * n];
+        double d = fabs(acc - W[j] * A[i + j * n]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dsyev", maxe, 1e-8);
+    free(A); free(As); free(W);
+  }
+
+  /* gesvd */
+  {
+    int64_t k = n;
+    double *A = malloc(m * n * 8), *As = malloc(m * n * 8);
+    double *S = malloc(k * 8), *U = malloc(m * k * 8), *VT = malloc(k * n * 8);
+    for (int64_t i = 0; i < m * n; ++i) As[i] = A[i] = frand();
+    int info = slate_dgesvd('s', 's', m, n, A, m, S, U, m, VT, k);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < m; ++i) {
+        double acc = 0;
+        for (int64_t kk = 0; kk < k; ++kk)
+          acc += U[i + kk * m] * S[kk] * VT[kk + j * k];
+        double d = fabs(acc - As[i + j * m]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dgesvd", maxe, 1e-8);
+    free(A); free(As); free(S); free(U); free(VT);
+  }
+
+  /* gridinit path: same posv through a 2x4 grid when 8 devices exist */
+  {
+    if (slate_gridinit(2, 4) == 0) {
+      double *A = malloc(n * n * 8), *S = malloc(n * n * 8), *Ss = malloc(n * n * 8);
+      double *B = malloc(n * 8), *Bs = malloc(n * 8);
+      for (int64_t i = 0; i < n * n; ++i) A[i] = frand();
+      for (int64_t j = 0; j < n; ++j)
+        for (int64_t i = 0; i < n; ++i) {
+          double acc = (i == j) ? (double)n : 0.0;
+          for (int64_t k = 0; k < n; ++k) acc += A[i + k * n] * A[j + k * n];
+          Ss[i + j * n] = S[i + j * n] = acc;
+        }
+      for (int64_t i = 0; i < n; ++i) Bs[i] = B[i] = frand();
+      int info = slate_dposv('l', n, 1, S, n, B, n);
+      double maxe = info == 0 ? 0 : 1e9;
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = 0;
+        for (int64_t k = 0; k < n; ++k) acc += Ss[i + k * n] * B[k];
+        double d = fabs(acc - Bs[i]);
+        if (d > maxe) maxe = d;
+      }
+      /* the distributed route computes in the array dtype (f64 on CPU) */
+      fails += check("grid-posv", maxe, 1e-8);
+      slate_gridexit();
+      free(A); free(S); free(Ss); free(B); free(Bs);
+    } else {
+      printf("grid-posv  skipped (no 8-device mesh)\n");
+    }
+  }
+
+  printf(fails == 0 ? "C_API PASS\n" : "C_API FAIL\n");
+  slate_finalize();
+  return fails;
+}

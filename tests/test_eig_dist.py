@@ -47,6 +47,24 @@ class TestHeevDistributed:
         np.testing.assert_allclose(np.sort(np.asarray(lam)),
                                    np.linalg.eigvalsh(A), atol=2e-4)
 
+    def test_vectors_dc_routes_stedc(self, grid):
+        """method_eig='dc' with vectors must go through the distributed stedc
+        merge path, not just steqr."""
+        n = 40
+        M = rng(42).standard_normal((n, n)).astype(np.float32)
+        A = (M + M.T) / 2
+        lam, Z = heev_distributed(jnp.asarray(A), grid, nb=8, method_eig="dc")
+        lam, Z = np.asarray(lam), np.asarray(Z)
+        np.testing.assert_allclose(np.sort(lam), np.linalg.eigvalsh(A),
+                                   atol=2e-4)
+        assert np.abs(A @ Z - Z * lam[None, :]).max() < 5e-3
+
+    def test_tiny_input_falls_back(self, grid):
+        lam, Z = heev_distributed(jnp.ones((1, 1), jnp.float32), grid)
+        assert np.allclose(np.asarray(lam), [1.0])
+        S, U, VT = svd_distributed(jnp.ones((2, 3), jnp.float32), grid)
+        assert np.asarray(S).shape == (2,)
+
     def test_complex(self, grid):
         n = 24
         r = rng(3)

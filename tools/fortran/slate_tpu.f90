@@ -1,0 +1,91 @@
+! Fortran bindings for the slate_tpu C API (include/slate_tpu.h).
+!
+! Reference analogue: tools/fortran generates iso_c_binding wrappers over the
+! C API; this module is the hand-written equivalent for the TPU build.
+!
+!   use slate_tpu
+!   info = slate_dgesv(n, nrhs, A, lda, ipiv, B, ldb)
+!
+! Link with -lslate_c_api (which embeds the Python runtime).
+
+module slate_tpu
+  use iso_c_binding
+  implicit none
+
+  interface
+     integer(c_int) function slate_init() bind(c, name="slate_init")
+       import :: c_int
+     end function slate_init
+
+     subroutine slate_finalize() bind(c, name="slate_finalize")
+     end subroutine slate_finalize
+
+     integer(c_int) function slate_gridinit(p, q) bind(c, name="slate_gridinit")
+       import :: c_int
+       integer(c_int), value :: p, q
+     end function slate_gridinit
+
+     subroutine slate_gridexit() bind(c, name="slate_gridexit")
+     end subroutine slate_gridexit
+
+     integer(c_int) function slate_dgemm(transa, transb, m, n, k, alpha, &
+          A, lda, B, ldb, beta, C, ldc) bind(c, name="slate_dgemm")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: transa, transb
+       integer(c_int64_t), value :: m, n, k, lda, ldb, ldc
+       real(c_double), value :: alpha, beta
+       real(c_double), intent(in) :: A(*), B(*)
+       real(c_double), intent(inout) :: C(*)
+     end function slate_dgemm
+
+     integer(c_int) function slate_dgesv(n, nrhs, A, lda, ipiv, B, ldb) &
+          bind(c, name="slate_dgesv")
+       import :: c_int, c_int64_t, c_double
+       integer(c_int64_t), value :: n, nrhs, lda, ldb
+       real(c_double), intent(inout) :: A(*), B(*)
+       integer(c_int64_t), intent(out) :: ipiv(*)
+     end function slate_dgesv
+
+     integer(c_int) function slate_dposv(uplo, n, nrhs, A, lda, B, ldb) &
+          bind(c, name="slate_dposv")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: uplo
+       integer(c_int64_t), value :: n, nrhs, lda, ldb
+       real(c_double), intent(inout) :: A(*), B(*)
+     end function slate_dposv
+
+     integer(c_int) function slate_dpotrf(uplo, n, A, lda) &
+          bind(c, name="slate_dpotrf")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: uplo
+       integer(c_int64_t), value :: n, lda
+       real(c_double), intent(inout) :: A(*)
+     end function slate_dpotrf
+
+     integer(c_int) function slate_dgels(trans, m, n, nrhs, A, lda, B, ldb) &
+          bind(c, name="slate_dgels")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: trans
+       integer(c_int64_t), value :: m, n, nrhs, lda, ldb
+       real(c_double), intent(inout) :: A(*), B(*)
+     end function slate_dgels
+
+     integer(c_int) function slate_dsyev(jobz, uplo, n, A, lda, W) &
+          bind(c, name="slate_dsyev")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: jobz, uplo
+       integer(c_int64_t), value :: n, lda
+       real(c_double), intent(inout) :: A(*)
+       real(c_double), intent(out) :: W(*)
+     end function slate_dsyev
+
+     real(c_double) function slate_dlange(norm, m, n, A, lda) &
+          bind(c, name="slate_dlange")
+       import :: c_int64_t, c_double, c_char
+       character(kind=c_char), value :: norm
+       integer(c_int64_t), value :: m, n, lda
+       real(c_double), intent(in) :: A(*)
+     end function slate_dlange
+  end interface
+
+end module slate_tpu
