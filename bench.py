@@ -58,7 +58,12 @@ BASELINES = {
     "svd": 100.0,      # dgesvd values n=4096 on 8n^3/3 model
 }
 
-CONFIGS = ["gemm", "potrf", "getrf", "gels", "heev", "svd"]
+# ordered safest-first: a child killed mid-execution can wedge the
+# single-session TPU tunnel for every later child, so the configs proven
+# cheap/robust on hardware run before the risky ones (LU last: both the fused
+# and tournament paths are slow enough at n=16384 to risk the per-config
+# timeout)
+CONFIGS = ["gemm", "potrf", "gels", "heev", "svd", "getrf"]
 HEADLINE = "gemm"
 
 # ---------------------------------------------------------------------------
@@ -81,23 +86,42 @@ def child_probe():
            "device_kind": devs[0].device_kind, "n_devices": len(devs), "sum": s})
 
 
-def _chain_rate(make_body, a0, k_small, k_large, flops_per_iter, repeats=3):
+def _chain_rate(body, a0, consts, k_small, k_large, flops_per_iter, repeats=3):
     """GFLOP/s via chain-length delta: timing (k_large - k_small) extra
-    iterations of a data-dependent loop inside one jit call cancels dispatch and
-    transfer overhead (the TPU tunnel round-trip is ~70 ms — larger than many
-    single calls at these sizes)."""
+    iterations of a data-dependent loop inside one jit call cancels dispatch
+    and transfer overhead (the TPU tunnel round-trip is ~70 ms — larger than
+    many single calls at these sizes).  The chain is mandatory on the tunnel
+    backend, which memoizes repeated identical executions.
+
+    ``body(i, carry, *consts)``: loop-invariant operands MUST come through
+    ``consts`` (jit arguments), never closures — a closed-over array becomes
+    an HLO constant shipped inside the remote-compile request, and the tunnel
+    rejects bodies past ~128 MB (HTTP 413; a 16k x 16k f32 operand is 1 GB).
+
+    Timing protocol: the tunnel backend defers execution (block_until_ready
+    returns immediately), so each timed call ends with a one-element fetch,
+    which forces the whole computation; every repeat gets a freshly perturbed
+    carry so no caching layer can satisfy it.
+    """
     import jax
     from jax import lax
 
     def timed(k):
-        fn = jax.jit(lambda a: lax.fori_loop(0, k, make_body(), a))
-        fn(a0).block_until_ready()  # compile + warm
+        fn = jax.jit(lambda c0, *cs: lax.fori_loop(
+            0, k, lambda i, c: body(i, c, *cs), c0))
+        float(jnp_ravel0(fn(a0, *consts)))   # compile + warm (forced)
         ts = []
-        for _ in range(repeats):
+        for j in range(repeats):
+            c0 = a0 + (j + 1) * 1e-7
+            float(jnp_ravel0(c0))            # materialize before the clock
             t0 = time.perf_counter()
-            fn(a0).block_until_ready()
+            r = fn(c0, *consts)
+            float(jnp_ravel0(r))             # fetch forces execution
             ts.append(time.perf_counter() - t0)
         return min(ts)
+
+    def jnp_ravel0(x):
+        return x.ravel()[0]
 
     t_small = timed(k_small)
     t_large = timed(k_large)
@@ -120,15 +144,13 @@ def child_gemm(cpu_fallback):
     b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), dtype=jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.asarray(n, jnp.float32))
 
-    def make_body():
-        def body(i, c):
-            # the framework's gemm always computes at lax.Precision.HIGHEST
-            # (ops/blas3.py), which is what the f32hi metric name asserts
-            return slate_tpu.gemm(scale, c, b, 0.0, c)
-        return body
+    def body(i, c, b, scale):
+        # the framework's gemm always computes at lax.Precision.HIGHEST
+        # (ops/blas3.py), which is what the f32hi metric name asserts
+        return slate_tpu.gemm(scale, c, b, 0.0, c)
 
     ks, kl = (2, 10) if cpu_fallback else (8, 136)
-    gflops, per_iter = _chain_rate(make_body, a, ks, kl, 2.0 * n**3)
+    gflops, per_iter = _chain_rate(body, a, (b, scale), ks, kl, 2.0 * n**3)
     _emit({"metric": f"gemm_f32hi_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
 
@@ -152,14 +174,18 @@ def child_potrf(cpu_fallback):
 
     import slate_tpu
 
-    def make_body():
-        def body(i, c):
-            ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
-            return slate_tpu.potrf(ap)[0]
-        return body
+    # the blocked Tiled target: XLA's fused Cholesky serializes its internal
+    # panel steps and crawls at large n on TPU; the framework's right-looking
+    # blocked factorization keeps the trailing updates as big MXU gemms —
+    # the reason SLATE-style blocking exists (potrf.cc:84-195)
+    opts = {"target": "tiled", "block_size": 2048}
 
-    ks, kl = (1, 3) if cpu_fallback else (2, 10)
-    gflops, per_iter = _chain_rate(make_body, a, ks, kl, n**3 / 3.0)
+    def body(i, c, a):
+        ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
+        return slate_tpu.potrf(ap, opts=opts)[0]
+
+    gflops, per_iter = _chain_rate(body, a, (a,), 1, 3, n**3 / 3.0,
+                                   repeats=2)
     _emit({"metric": f"potrf_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
 
@@ -177,15 +203,20 @@ def child_getrf(cpu_fallback):
 
     import slate_tpu
 
-    def make_body():
-        def body(i, c):
-            ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
-            return slate_tpu.getrf(ap)[0]
-        return body
+    # tournament pivoting (getrf_tntpiv): partial-pivot via the fused
+    # lax.linalg.lu provably does not finish a single n=16384 call on the
+    # tunnel within the config budget, while CALU keeps the panel work as
+    # sorts+gemms — the SURVEY §7 prediction that tournament pivoting is the
+    # better-fit default on TPU
+    opts = {"method_lu": "calu", "block_size": 2048}
 
-    ks, kl = (1, 3) if cpu_fallback else (2, 10)
-    gflops, per_iter = _chain_rate(make_body, a, ks, kl, 2.0 * n**3 / 3.0)
-    _emit({"metric": f"getrf_f32_n{n}_gflops", "value": round(gflops, 1),
+    def body(i, c, a):
+        ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
+        return slate_tpu.getrf(ap, opts=opts)[0]
+
+    gflops, per_iter = _chain_rate(body, a, (a,), 1, 3, 2.0 * n**3 / 3.0,
+                                   repeats=2)
+    _emit({"metric": f"getrf_calu_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
 
 
@@ -206,21 +237,15 @@ def child_gels(cpu_fallback):
 
     import slate_tpu
 
-    def cholqr_solve(a, b):
+    def body(i, bc, a):
         # the framework's CholeskyQR2 least-squares path (linalg/qr.py
-        # gels_cholqr — fully jittable since the lax.cond restructure)
-        return slate_tpu.gels_cholqr(a, b)
+        # gels_cholqr — fully jittable since the lax.cond restructure);
+        # the carry perturbs b so the tunnel cannot memoize iterations
+        X = slate_tpu.gels_cholqr(a, bc)
+        return bc + 1e-6 * X[0, 0]
 
-    fn = jax.jit(cholqr_solve)
-    fn(a, b).block_until_ready()
-    ts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        fn(a, b).block_until_ready()
-        ts.append(time.perf_counter() - t0)
-    sec = min(ts)
     flops = 2.0 * n * n * (m - n / 3.0) + 4.0 * m * n * nrhs
-    gflops = flops / sec / 1e9
+    gflops, sec = _chain_rate(body, b, (a,), 1, 3, flops, repeats=2)
     _emit({"metric": f"gels_cholqr_f32_{m}x{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "m": m, "n": n, "sec_per_call": sec})
 
@@ -239,15 +264,14 @@ def child_heev(cpu_fallback):
 
     import slate_tpu
 
-    fn = jax.jit(lambda a: slate_tpu.heev(a, uplo="lower", want_vectors=False)[0])
-    fn(a).block_until_ready()
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        fn(a).block_until_ready()
-        ts.append(time.perf_counter() - t0)
-    sec = min(ts)
-    gflops = (4.0 * n**3 / 3.0) / sec / 1e9
+    def body(i, c, a):
+        ap = a + (1e-6 * c[0]) * jnp.eye(n, dtype=a.dtype)
+        lam = slate_tpu.heev(ap, uplo="lower", want_vectors=False)[0]
+        return c + 1e-6 * lam
+
+    c0 = jnp.zeros((n,), jnp.float32)
+    gflops, sec = _chain_rate(body, c0, (a,), 1, 2, 4.0 * n**3 / 3.0,
+                              repeats=2)
     _emit({"metric": f"heev_vals_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
 
@@ -264,15 +288,14 @@ def child_svd(cpu_fallback):
 
     import slate_tpu
 
-    fn = jax.jit(lambda a: slate_tpu.svd_vals(a))
-    fn(a).block_until_ready()
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        fn(a).block_until_ready()
-        ts.append(time.perf_counter() - t0)
-    sec = min(ts)
-    gflops = (8.0 * n**3 / 3.0) / sec / 1e9
+    def body(i, c, a):
+        ap = a + (1e-6 * c[0]) * jnp.eye(n, dtype=a.dtype)
+        s = slate_tpu.svd_vals(ap)
+        return c + 1e-6 * s
+
+    c0 = jnp.zeros((n,), jnp.float32)
+    gflops, sec = _chain_rate(body, c0, (a,), 1, 2, 8.0 * n**3 / 3.0,
+                              repeats=2)
     _emit({"metric": f"svd_vals_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
 
@@ -357,6 +380,15 @@ def main():
                                  timeout=min(900, max(120, budget)))
                 detail["attempts"].append({"config": name, "attempt": attempt, **res})
                 if res.get("ok"):
+                    break
+                # a killed child may have wedged the tunnel; re-probe before
+                # spending more TPU budget (a dead tunnel hangs, not errors)
+                reprobe = _run_child("probe", cpu_fallback=False, timeout=180)
+                detail["attempts"].append({"config": "reprobe", **reprobe})
+                if not (reprobe.get("ok")
+                        and reprobe.get("platform") not in (None, "cpu")):
+                    tpu_up = False
+                    detail["backend"] = "cpu-fallback (tunnel lost)"
                     break
                 time.sleep(10)
         if not (res and res.get("ok")):

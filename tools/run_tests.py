@@ -20,10 +20,17 @@ import xml.etree.ElementTree as ET
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# the tester must not hang on a wedged TPU tunnel: default to CPU unless the
-# caller explicitly set a platform (the bench path sets its own)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+# The tester must not land on the TPU tunnel: the ambient environment PINS
+# JAX_PLATFORMS to the axon plugin, so setdefault() is not a defense — force
+# CPU unless the caller explicitly opts into a platform via
+# SLATE_TESTER_PLATFORM (correctness sweeps are platform-agnostic; the bench
+# path owns the TPU).
+_plat = os.environ.get("SLATE_TESTER_PLATFORM") or "cpu"
+os.environ["JAX_PLATFORMS"] = _plat
+if _plat == "cpu":
+    # JAX_PLATFORMS=cpu alone is not enough: the sitecustomize hook registers
+    # the TPU plugin and can hang on a wedged tunnel; empty POOL_IPS skips it
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 from slate_tpu.testing import ROUTINES                          # noqa: E402
 from slate_tpu.testing.driver import run_sweep                  # noqa: E402
