@@ -126,6 +126,11 @@ def _chain_rate(body, a0, consts, k_small, k_large, flops_per_iter, repeats=3):
     t_small = timed(k_small)
     t_large = timed(k_large)
     per_iter = (t_large - t_small) / (k_large - k_small)
+    if per_iter <= 0:
+        # short chains on fast ops can lose the delta to timing noise; fall
+        # back to the overhead-inclusive total (always positive, and an
+        # *under*-estimate of the rate — never an absurd number)
+        per_iter = t_large / k_large
     return flops_per_iter / per_iter / 1e9, per_iter
 
 

@@ -50,7 +50,7 @@ class MatrixStorage:
     """
 
     __slots__ = ("array", "mb", "nb", "tile_rank", "grid", "kind", "p", "q",
-                 "order", "default_rank_map")
+                 "order", "default_rank_map", "pool", "__weakref__")
 
     def __init__(self, array: jax.Array, mb: int, nb: int,
                  p: int = 1, q: int = 1, order: GridOrder = GridOrder.Col,
@@ -74,6 +74,8 @@ class MatrixStorage:
         if (grid is not None and getattr(grid, "size", 1) > 1
                 and hasattr(grid, "spec") and getattr(array, "ndim", 0) == 2):
             self.array = jax.device_put(array, grid.spec())
+        if _pool_tracking:
+            _register_storage(self)
 
     @property
     def m(self) -> int:
@@ -159,6 +161,10 @@ class BaseMatrix:
                                       self.joffset // self.storage.nb + j)
 
     def tileIsLocal(self, i: int, j: int) -> bool:
+        """Whether tile (i, j) is owned by this process's rank on the grid
+        (BaseMatrix::tileIsLocal).  Without a grid everything is local; with
+        one, ProcessGrid.rank resolves the controller's flattened position
+        (multi-host aware via jax.local_devices)."""
         g = self.storage.grid
         rank = 0 if g is None else getattr(g, "rank", 0)
         return self.tileRank(i, j) == rank
@@ -534,6 +540,58 @@ class HermitianBandMatrix(BaseBandMatrix):
 # ---------------------------------------------------------------------------
 # Helpers used across drivers
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# workspace-pool accounting (reference Memory.cc + reserveDeviceWorkspace):
+# XLA owns the HBM, so the pool tracks tile-granular budget for the debug
+# invariants (Debug::printNumFreeMemBlocks).  Opt-in — zero overhead unless
+# enabled — because skins construct wrappers in hot paths.
+
+_pool_tracking = False
+_live_storages: "Any" = None
+
+
+def enable_pool_tracking(on: bool = True) -> None:
+    """Track every subsequently-built MatrixStorage in a per-storage native
+    pool (one block per tile) plus a process-wide live registry — the data
+    path behind utils.debug.check_no_leaks / live_workspace_report."""
+    global _pool_tracking, _live_storages
+    _pool_tracking = bool(on)
+    if on and _live_storages is None:
+        import weakref
+
+        _live_storages = weakref.WeakSet()
+
+
+def _register_storage(s: "MatrixStorage") -> None:
+    from .. import native
+
+    arr = s.array
+    itemsize = getattr(getattr(arr, "dtype", None), "itemsize", 4)
+    mt = -(-arr.shape[-2] // s.mb) if getattr(arr, "ndim", 0) >= 2 else 1
+    nt = -(-arr.shape[-1] // s.nb) if getattr(arr, "ndim", 0) >= 2 else 1
+    # capacity = the storage's resident tiles; blocks are *allocated* only for
+    # transient workspace (drivers may pool.alloc()/free() around scratch),
+    # so a healthy storage keeps in_use == 0 and check_no_leaks stays usable
+    s.pool = native.MemoryPool(s.mb * s.nb * itemsize, max(mt * nt, 1))
+    _live_storages.add(s)
+
+
+def live_workspace_report():
+    """(n_storages, total_resident_bytes) across live tracked storages — the
+    Debug::printNumFreeMemBlocks analogue (capacity = resident tiles; any
+    nonzero pool.in_use on top is outstanding workspace)."""
+    if not _live_storages:
+        return 0, 0
+    total = 0
+    count = 0
+    for s in list(_live_storages):
+        pool = getattr(s, "pool", None)
+        if pool is not None:
+            total += pool.capacity * pool.block_bytes
+            count += 1
+    return count, total
 
 
 def distribution_grid(*operands):

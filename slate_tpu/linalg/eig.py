@@ -168,11 +168,14 @@ def hegv(itype: int, A, B, opts=None, uplo=None, want_vectors: bool = True):
 
 
 def default_band_nb(n: int, opts: Optional[Options] = None) -> int:
-    """Bandwidth for the two-stage reduction: the Options block size, capped so
-    small matrices still get a non-trivial band (reference uses Option::BlockSize,
-    he2hb.cc)."""
+    """Bandwidth for the two-stage reduction: the Options block size capped at
+    64 and at n/4 (reference he2hb takes its own band nb, typically much
+    smaller than the gemm blocking).  The cap matters for compile time: the
+    masked panel QR traces O(nb) ops per panel, so nb=256 inflates the jit
+    program ~4x for little chase-side gain.  Pass nb explicitly to he2hb /
+    hb2st to override."""
     nb = opts.block_size if opts is not None else 256
-    return max(2, min(nb, max(2, n // 4)))
+    return max(2, min(nb, 64, max(2, n // 4)))
 
 
 def he2hb(A, opts=None, uplo=None, nb: Optional[int] = None):

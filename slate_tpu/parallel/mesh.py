@@ -73,6 +73,29 @@ class ProcessGrid:
             return rank % self.p, rank // self.p
         return rank // self.q, rank % self.q
 
+    @property
+    def rank(self) -> int:
+        """This process's flattened grid rank (Cblacs_pcoord's myrow/mycol
+        inverse).  Under single-controller SPMD every device is addressable,
+        so the controller's rank is the first grid position owned by one of
+        this process's local devices — 0 in single-process runs, and the
+        process's first device slot under jax.distributed (multi-host).
+        Cached: the mesh is fixed at construction and tileIsLocal reads this
+        per tile."""
+        cached = getattr(self, "_rank", None)
+        if cached is not None:
+            return cached
+        local = set(jax.local_devices())
+        flat = (self.mesh.devices.T if self.order == GridOrder.Col
+                else self.mesh.devices).ravel()
+        rank = 0
+        for r, d in enumerate(flat):
+            if d in local:
+                rank = r
+                break
+        self._rank = rank
+        return rank
+
     # -- shardings -----------------------------------------------------------
     def spec(self, row_shard: bool = True, col_shard: bool = True,
              extra_leading: int = 0) -> NamedSharding:

@@ -18,8 +18,10 @@ from ..core.matrix import (BaseBandMatrix, BaseMatrix, BaseTrapezoidMatrix,
                            HermitianMatrix, SymmetricMatrix, as_array)
 from ..core.types import Uplo
 
+from ..core.matrix import enable_pool_tracking, live_workspace_report
+
 __all__ = ["check_finite", "check_owner_map", "check_structure", "check_no_leaks",
-           "tile_summary"]
+           "tile_summary", "enable_pool_tracking", "live_workspace_report"]
 
 
 def check_finite(A, name: str = "A") -> bool:

@@ -8,26 +8,19 @@ precision residual gates; TPU runs use f32/bf16 (see bench.py).
 """
 
 import os
+import sys
 
-# Must be set before jax initializes its backends. The ambient environment pins
-# JAX_PLATFORMS to the real TPU platform; tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# Must run before jax initializes its backends: pin the virtual 8-device CPU
+# mesh and defuse the ambient TPU-tunnel plugin (shared defense with
+# tools/run_tests.py — single source of truth in tools/force_cpu.py).
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "tools"))
+from force_cpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend(virtual_devices=8)
 
 import jax  # noqa: E402
 
-# If a TPU PJRT plugin was registered by a sitecustomize hook, drop it so tests never
-# touch the (single-session) real-TPU tunnel: tests run on the virtual CPU mesh only.
-try:  # pragma: no cover - environment-specific
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
-
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

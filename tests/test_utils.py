@@ -140,3 +140,42 @@ class TestDebug:
         A = slate.Matrix(32, 32, nb=8, p=2, q=2)
         s = debug.tile_summary(A)
         assert "rank 0: 4 tiles" in s and "grid 2x2" in s
+
+
+class TestPoolTracking:
+    """Workspace-pool accounting wired into MatrixStorage (Memory.cc +
+    Debug::printNumFreeMemBlocks analogue; opt-in)."""
+
+    def test_live_workspace_report(self):
+        import gc
+        import jax.numpy as jnp
+        import slate_tpu as slate
+        from slate_tpu.utils import debug
+
+        debug.enable_pool_tracking(True)
+        try:
+            M = slate.Matrix.from_array(jnp.zeros((64, 64), jnp.float32), nb=16)
+            count, total = debug.live_workspace_report()
+            assert count >= 1
+            assert total >= 16 * 16 * 4 * 16  # 4x4 tiles of 16x16 f32
+            pool = M.storage.pool
+            assert pool.capacity == 16 and pool.in_use == 0
+            debug.check_no_leaks(pool, "M")  # healthy storage passes
+            # transient workspace: alloc/free round-trip keeps it leak-free
+            bid = pool.alloc()
+            assert bid >= 0 and pool.in_use == 1
+            assert pool.free(bid) and pool.in_use == 0
+            debug.check_no_leaks(pool, "M")
+            del M
+            gc.collect()
+            count2, _ = debug.live_workspace_report()
+            assert count2 <= count - 1  # weak registry drops dead storages
+        finally:
+            debug.enable_pool_tracking(False)
+
+    def test_tracking_off_is_free(self):
+        import jax.numpy as jnp
+        import slate_tpu as slate
+
+        M = slate.Matrix.from_array(jnp.zeros((8, 8), jnp.float32), nb=4)
+        assert getattr(M.storage, "pool", None) is None

@@ -1,0 +1,39 @@
+"""Shared defense against the ambient TPU-tunnel pin (single source of truth
+for tests/conftest.py and tools/run_tests.py).
+
+The environment pins ``JAX_PLATFORMS`` to the axon PJRT plugin, and the
+sitecustomize hook has already registered (and monkeypatched in) that plugin
+by the time any repo code runs — env vars alone are no defense, and a wedged
+tunnel hangs forever in backend init.  Call ``force_cpu_backend()`` before any
+JAX computation: it pins the env, drops the plugin's backend factory, and
+re-pins the live config.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(virtual_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend, defusing the TPU plugin.
+
+    ``virtual_devices`` adds ``--xla_force_host_platform_device_count`` when
+    the flag is not already present (the virtual mesh the test tiers use).
+    Must run before JAX initializes a backend; importing jax alone does not.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={virtual_devices}")
+    import jax
+
+    try:  # pragma: no cover - environment-specific
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")

@@ -26,11 +26,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # SLATE_TESTER_PLATFORM (correctness sweeps are platform-agnostic; the bench
 # path owns the TPU).
 _plat = os.environ.get("SLATE_TESTER_PLATFORM") or "cpu"
-os.environ["JAX_PLATFORMS"] = _plat
 if _plat == "cpu":
-    # JAX_PLATFORMS=cpu alone is not enough: the sitecustomize hook registers
-    # the TPU plugin and can hang on a wedged tunnel; empty POOL_IPS skips it
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    # correctness sweeps never touch the single-session TPU tunnel; shared
+    # defense with tests/conftest.py
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from force_cpu import force_cpu_backend
+
+    force_cpu_backend()
+else:
+    os.environ["JAX_PLATFORMS"] = _plat
 
 from slate_tpu.testing import ROUTINES                          # noqa: E402
 from slate_tpu.testing.driver import run_sweep                  # noqa: E402
@@ -82,9 +86,11 @@ def main(argv=None) -> int:
     def progress(r):
         status = r.status if r.ok else f"** {r.status} **"
         err = r.error if r.error is not None else float("nan")
+        gf = f"{r.gflops:8.1f}" if r.gflops is not None else "       -"
+        tm = f"{r.time_s:8.4f}" if r.time_s is not None else "       -"
         print(f"{r.routine:16s} {r.params.get('dtype')} "
               f"{r.params['m']:5d}x{r.params['n']:<5d} nb={r.params['nb']:<4d} "
-              f"err={err:.2e} {status} {r.message}", flush=True)
+              f"t={tm}s gf={gf} err={err:.2e} {status} {r.message}", flush=True)
 
     t0 = time.time()
     results = run_sweep(names, dims, parse_list(args.type), cfg["nb"],
