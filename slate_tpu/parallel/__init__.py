@@ -23,3 +23,4 @@ from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       geqrf_distributed, gels_caqr_distributed)
 from .eig_dist import (heev_distributed, svd_distributed, norm_distributed,
                        col_norms_distributed)
+from .pipeline import potrf_pipelined

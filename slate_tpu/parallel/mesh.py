@@ -88,7 +88,7 @@ class ProcessGrid:
         local = set(jax.local_devices())
         flat = (self.mesh.devices.T if self.order == GridOrder.Col
                 else self.mesh.devices).ravel()
-        rank = 0
+        rank = -1   # no local device on this grid -> this process owns nothing
         for r, d in enumerate(flat):
             if d in local:
                 rank = r

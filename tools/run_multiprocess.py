@@ -38,14 +38,14 @@ def _free_port() -> int:
 
 
 def worker(coord: str, pid: int) -> None:
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from force_cpu import force_cpu_backend  # shared TPU-plugin defense
+
+    force_cpu_backend(virtual_devices=LOCAL_DEVICES)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=NPROC, process_id=pid)
     import numpy as np
