@@ -21,7 +21,7 @@ def main():
     # factor / solve split + inverse + condition estimate
     L, info = slate.potrf(slate.HermitianMatrix.from_array(slate.Uplo.Lower,
                                                            a.copy(), nb=64))
-    rcond = float(slate.pocondest(np.asarray(L.array), slate.norm("one", M)))
+    rcond = float(slate.pocondest(np.asarray(L), slate.norm("one", M)))
     print("pocondest rcond:", rcond)
     assert 0 < rcond < 1
     print("ex07 OK")

@@ -21,7 +21,7 @@ def main():
 
     # the explicit two-stage pipeline (ge2tb -> tb2bd -> bdsqr)
     d, e, U1, VT1 = slate.ge2tb(a[:32, :24])
-    sv2 = np.asarray(slate.bdsqr(d, e))
+    sv2 = np.asarray(slate.bdsqr(d, e)[0])
     np.testing.assert_allclose(np.sort(sv2)[::-1],
                                np.linalg.svd(a[:32, :24], compute_uv=False),
                                rtol=1e-3)

@@ -25,7 +25,7 @@ def main():
     # the hegst standard-form transform by itself
     L, info = slate.potrf(slate.HermitianMatrix.from_array(slate.Uplo.Lower,
                                                            bmat.copy(), nb=32))
-    C = slate.hegst(1, a, np.asarray(L.array))
+    C = slate.hegst(1, a, np.asarray(L))
     np.testing.assert_allclose(np.sort(np.linalg.eigvalsh(np.asarray(C))),
                                ref, rtol=1e-2, atol=1e-3)
     print("ex12 OK")

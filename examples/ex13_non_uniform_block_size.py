@@ -21,7 +21,7 @@ def main():
     import jax.numpy as jnp
     st = MatrixStorage(jnp.asarray(a), 3, 4, p=2, q=1,
                        tile_rank=func.process_1d_grid("col", 2))
-    M = Matrix(_storage=st)
+    M = Matrix(7, 10, 4, _storage=st)
     om = M.owner_map()
     np.testing.assert_array_equal(om[:, 0], [0, 1, 0])   # i % 2 down rows
 

@@ -21,7 +21,8 @@ def main():
     # add: B = alpha A + beta B
     B = slate.Matrix.from_array(np.ones((6, 6), np.float32), nb=2)
     slate.add(2.0, A, 1.0, B)
-    assert np.asarray(B.array)[0, 0] == 2 * 1.5 + 1
+    assert np.asarray(B.array)[0, 1] == 2 * 1.5 + 1   # offdiag
+    assert np.asarray(B.array)[0, 0] == 2 * 7.5 + 1   # diag
 
     # named generator kinds (matgen)
     hilb, _ = slate.generate_matrix("hilb", 4)

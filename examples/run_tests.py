@@ -12,8 +12,12 @@ REPO = os.path.dirname(HERE)
 
 env = dict(os.environ)
 env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-env.setdefault("JAX_PLATFORMS", "cpu")
-env.setdefault("PALLAS_AXON_POOL_IPS", "")
+# force CPU (the ambient env PINS the TPU tunnel platform, so setdefault is
+# no defense — see tools/force_cpu.py); opt into another platform explicitly
+_plat = os.environ.get("SLATE_EXAMPLES_PLATFORM", "cpu")
+env["JAX_PLATFORMS"] = _plat
+if _plat == "cpu":
+    env["PALLAS_AXON_POOL_IPS"] = ""
 flags = env.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -37,7 +37,6 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import numpy as np
 
-from ..core.exceptions import slate_assert
 from .mesh import ProcessGrid
 
 _AXIS = "d"
@@ -48,7 +47,7 @@ def _potrf_pipelined_fn(mesh, n: int, nb: int, d: int, dtype_str: str):
     nt = n // nb
     nt_loc = nt // d
 
-    def local_cols(Lloc, me):
+    def local_cols(me):
         """Global block-column index of each local slot: j(s) = s*d + me."""
         return jnp.arange(nt_loc) * d + me
 
@@ -71,7 +70,7 @@ def _potrf_pipelined_fn(mesh, n: int, nb: int, d: int, dtype_str: str):
         """Rank-nb update of every local column with global index >= j_min:
         L[:, j] -= P_k @ P_k[rows of block j]^H (internal::herk/gemm trailing
         update, potrf.cc:136-148)."""
-        js = local_cols(Lloc, me)                      # (nt_loc,)
+        js = local_cols(me)                            # (nt_loc,)
         Gall = P_k.reshape(nt, nb, nb)
         G = Gall[js]                                   # (nt_loc, nb, nb)
         upd = jnp.einsum("nk,smk->nsm", P_k, jnp.conj(G),
@@ -148,14 +147,11 @@ def potrf_pipelined(Af: jax.Array, grid: ProcessGrid, nb: int = 256) -> jax.Arra
 
     # block-cyclic column permutation: shard s of device m holds global
     # block-column s*d + m; the sharded axis layout is device-major, so
-    # pre-permute columns into (device, slot) order and undo after
-    blocks = np.arange(nt)
-    dev_of = blocks % d
-    slot_of = blocks // d
-    pos = dev_of * (nt // d) + slot_of           # position of block j
-    fwd = np.argsort(pos * nt + blocks)          # stable: global j -> layout
-    fwd_cols = (np.repeat(blocks[fwd] * nb, nb)
-                + np.tile(np.arange(nb), nt))
+    # pre-permute columns into (device, slot) order and undo after (shared
+    # layout bridge with redistribute, distribute.cyclic_permutation)
+    from .distribute import cyclic_permutation
+
+    fwd_cols = cyclic_permutation(n, nb, d)
     inv_cols = np.argsort(fwd_cols)
 
     Aperm = jnp.take(Ap, jnp.asarray(fwd_cols), axis=1)

@@ -1,0 +1,19 @@
+"""Examples double as the smoke tier (reference examples/run_tests.py;
+SURVEY.md §4) — keep them green under pytest so they cannot rot."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout(900)
+def test_examples_smoke_tier():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", "run_tests.py")],
+        capture_output=True, text=True, timeout=880)
+    sys.stdout.write(proc.stdout[-3000:])
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-1000:]

@@ -43,6 +43,14 @@ def worker(coord: str, pid: int) -> None:
     sys.path.insert(0, os.path.join(root, "tools"))
     from force_cpu import force_cpu_backend  # shared TPU-plugin defense
 
+    # each worker must own exactly LOCAL_DEVICES virtual devices; an ambient
+    # device-count flag (e.g. the test-suite's =8) would win inside
+    # force_cpu_backend's already-present check, so strip it first
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags)
     force_cpu_backend(virtual_devices=LOCAL_DEVICES)
     import jax
 
