@@ -192,7 +192,30 @@ def norm(norm_kind, A, opts=None, scope=NormScope.Matrix, uplo=None, diag=None):
     General -> genorm, symmetric/Hermitian -> synorm/henorm, triangular -> trnorm,
     band -> gbnorm/hbnorm (internal_*norm.cc family).
     """
+    from .core.matrix import distribution_grid
+    from .core.types import Norm
+
     a = as_array(A)
+    grid = distribution_grid(A)
+    kind = Norm.from_string(norm_kind)
+    the_scope = NormScope.from_string(scope)
+    if (grid is not None and a.ndim == 2
+            and kind in (Norm.Max, Norm.One, Norm.Inf, Norm.Fro)):
+        # wrapper bound to a >1-device grid: sharded masked reduction.
+        # Band and unit-diagonal triangles keep the local masked kernels.
+        from .parallel import col_norms_distributed, norm_distributed
+
+        general = not isinstance(A, (BaseTrapezoidMatrix, BaseBandMatrix))
+        if the_scope == NormScope.Columns and general and kind == Norm.Max:
+            return col_norms_distributed(a, grid)
+        if the_scope == NormScope.Matrix:
+            if isinstance(A, (HermitianMatrix, SymmetricMatrix)):
+                return norm_distributed(kind, A.full_array(), grid)
+            if (isinstance(A, BaseTrapezoidMatrix)
+                    and _diag_of(A, diag) != Diag.Unit):
+                return norm_distributed(kind, a, grid, uplo=str(A.uplo.value))
+            if general:
+                return norm_distributed(kind, a, grid)
     if isinstance(A, HermitianMatrix):
         return norm_ops.henorm(norm_kind, A.uplo, a)
     if isinstance(A, SymmetricMatrix):

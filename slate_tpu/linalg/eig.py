@@ -34,7 +34,7 @@ from jax import lax
 
 from ..core.exceptions import SlateError
 from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array,
-                           write_back)
+                           distribution_grid, write_back)
 from ..core.types import MethodEig, Norm, Options, Target, Uplo
 from ..ops import norms as norm_ops
 from ..utils.trace import Timers, trace_block
@@ -78,6 +78,17 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
     timers = Timers()
     a = _full_herm(A, uplo)
     n = a.shape[-1]
+    grid = distribution_grid(A)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: the distributed pipeline
+        # (sharded stage 1, replicated chase — parallel/eig_dist.py)
+        from ..parallel import heev_distributed
+
+        lam, z = heev_distributed(
+            a, grid, nb=default_band_nb(n, opts),
+            want_vectors=want_vectors,
+            method_eig="dc" if opts.method_eig == MethodEig.DC else "qr")
+        return (lam, z) if want_vectors else (lam, None)
     if method == "two_stage" and n < 8:
         method = "fused"  # no meaningful band structure below one panel
     with trace_block("heev", n=n):

@@ -43,6 +43,17 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
     a = as_array(A)
     m, n = a.shape[-2:]
     want_vectors = want_u or want_vt
+    from ..core.matrix import distribution_grid
+
+    grid = distribution_grid(A)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: distributed pipeline
+        from ..linalg.eig import default_band_nb
+        from ..parallel import svd_distributed
+
+        S, U, VT = svd_distributed(a, grid, nb=default_band_nb(min(m, n), opts),
+                                   want_vectors=want_vectors)
+        return S, (U if want_u else None), (VT if want_vt else None)
     if method == "two_stage":
         with trace_block("svd_two_stage", m=m, n=n):
             with timers.time("svd::scale"):

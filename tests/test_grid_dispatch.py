@@ -150,3 +150,55 @@ class TestScalapackDistributed:
         Lf, info = sk.pdpotrf("l", spd)
         assert info == 0
         assert np.abs(np.tril(Lf) @ np.tril(Lf).T - spd).max() < 1e-4
+
+
+class TestEigSvdNormGridRouting:
+    """heev/svd/norm drivers consume a wrapper's construction-time grid like
+    the factorization drivers do (MatrixStorage.hh:494-511 consumption)."""
+
+    def test_heev_wrapper_grid(self, grid):
+        n = 40
+        M = rng(30).standard_normal((n, n)).astype(np.float32)
+        A = (M + M.T) / 2
+        H = slate.HermitianMatrix.from_array("lower", jnp.asarray(np.tril(A)),
+                                             nb=8, grid=grid)
+        lam, Z = slate.heev(H)
+        lam, Z = np.asarray(lam), np.asarray(Z)
+        np.testing.assert_allclose(np.sort(lam), np.linalg.eigvalsh(A),
+                                   atol=2e-4)
+        assert np.abs(A @ Z - Z * lam[None, :]).max() < 5e-3
+
+    def test_svd_wrapper_grid(self, grid):
+        a = rng(31).standard_normal((40, 24)).astype(np.float32)
+        W = slate.Matrix.from_array(jnp.asarray(a), nb=8, grid=grid)
+        S, U, VT = slate.svd(W)
+        S, U, VT = map(np.asarray, (S, U, VT))
+        assert np.abs(U @ np.diag(S) @ VT - a).max() < 1e-3
+
+    def test_norm_wrapper_grid(self, grid):
+        a = rng(32).standard_normal((40, 24)).astype(np.float32)
+        W = slate.Matrix.from_array(jnp.asarray(a), nb=8, grid=grid)
+        for k, ref in [("fro", np.linalg.norm(a)),
+                       ("one", np.abs(a).sum(0).max()),
+                       ("inf", np.abs(a).sum(1).max()),
+                       ("max", np.abs(a).max())]:
+            assert abs(float(slate.norm(k, W)) - ref) < 1e-3 * max(ref, 1)
+
+    def test_norm_hermitian_wrapper_grid(self, grid):
+        n = 32
+        M = rng(33).standard_normal((n, n)).astype(np.float32)
+        A = (M + M.T) / 2
+        H = slate.HermitianMatrix.from_array("lower", jnp.asarray(np.tril(A)),
+                                             nb=8, grid=grid)
+        assert abs(float(slate.norm("one", H)) - np.abs(A).sum(0).max()) < 1e-3
+
+    def test_unit_diag_triangular_stays_local(self, grid):
+        """Unit-diagonal triangles keep the local masked kernel (the sharded
+        reduction has no unit-diag handling)."""
+        n = 24
+        a = np.tril(rng(34).standard_normal((n, n))).astype(np.float32)
+        T = slate.TriangularMatrix.from_array("lower", jnp.asarray(a), nb=8,
+                                              diag="unit", grid=grid)
+        got = float(slate.norm("max", T))
+        ref = np.abs(np.tril(a, -1) + np.eye(n)).max()
+        assert abs(got - ref) < 1e-5
