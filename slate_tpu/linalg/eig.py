@@ -61,7 +61,7 @@ def _safe_scale(a):
 
 
 def heev(A, opts=None, uplo=None, want_vectors: bool = True,
-         method: str = "fused"):
+         method: str = "fused", chase_pipeline: bool = False):
     """Hermitian eigensolve (src/heev.cc). Returns (Lambda ascending, Z or None).
 
     method:
@@ -99,7 +99,8 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
             with timers.time("heev::he2hb"):
                 band, Vs, Ts = he2hb(a, opts, nb=nb)
             with timers.time("heev::hb2st"):
-                out = hb2st(band, kd=nb, want_vectors=want_vectors)
+                out = hb2st(band, kd=nb, want_vectors=want_vectors,
+                            pipeline=chase_pipeline)
             with timers.time("heev::stev"):
                 if want_vectors:
                     d, e, Q2 = out
@@ -322,6 +323,33 @@ def unmtr_hb2st(side, op, V, C, opts=None):
     return _apply_q(side, op, V, C)
 
 
+def _two_sided(tau, v, D):
+    """D := H^H D H for H = I - tau v v^H (herf, internal_hebr.cc)."""
+    D = D - jnp.conj(tau) * jnp.outer(v, jnp.conj(v) @ D)
+    return D - tau * jnp.outer(D @ v, jnp.conj(v))
+
+
+def _hebr1_window(W):
+    """hebr1 on a (b+1, b+1) diagonal window: reflector zeroing col 0 below
+    the first subdiagonal + two-sided update.  Returns (W_updated, v, tau)."""
+    from . import householder as hh
+
+    x = W[1:, 0]
+    v, tau, _ = hh.larfg(x)
+    xn = x - jnp.conj(tau) * v * jnp.vdot(v, x)
+    W = W.at[1:, 0].set(xn)
+    W = W.at[0, 1:].set(jnp.conj(xn))
+    W = W.at[1:, 1:].set(_two_sided(tau, v, W[1:, 1:]))
+    return W, v, tau
+
+
+def _chase_extract(Ap, n):
+    """(d, e_complex) from the chased padded array."""
+    T = Ap[:n, :n]
+    idx = jnp.arange(n)
+    return jnp.real(jnp.diagonal(T)), T[idx[1:], idx[:-1]]
+
+
 def _hb2st_chase(Afull: jax.Array, kd: int):
     """The bulge-chasing kernel: full Hermitian band (bandwidth kd >= 2) ->
     complex-subdiagonal tridiagonal, via the reference's three task types
@@ -356,10 +384,6 @@ def _hb2st_chase(Afull: jax.Array, kd: int):
     taus0 = jnp.zeros((n_sweeps, m_max), dt)
     zi, zj = n + b + 1, n + 1  # zero-land window anchors for inactive steps
 
-    def two_sided(tau, v, D):
-        D = D - jnp.conj(tau) * jnp.outer(v, jnp.conj(v) @ D)
-        return D - tau * jnp.outer(D @ v, jnp.conj(v))
-
     def chase_body(r, inner):
         s, Ap, Vs, taus, v_prev, tau_prev = inner
         i = r * b + 1 + s
@@ -376,7 +400,7 @@ def _hb2st_chase(Afull: jax.Array, kd: int):
         Ap = lax.dynamic_update_slice(Ap, jnp.conj(W).T, (jj, ii))
         # hebr3: two-sided on the diagonal window
         D = lax.dynamic_slice(Ap, (ii, ii), (b, b))
-        D = two_sided(tau, v, D)
+        D = _two_sided(tau, v, D)
         Ap = lax.dynamic_update_slice(Ap, D, (ii, ii))
         Vs = Vs.at[s, r].set(v)
         taus = taus.at[s, r].set(tau)
@@ -386,12 +410,7 @@ def _hb2st_chase(Afull: jax.Array, kd: int):
         Ap, Vs, taus = carry
         # hebr1: first task of the sweep
         W = lax.dynamic_slice(Ap, (s, s), (b + 1, b + 1))
-        x = W[1:, 0]
-        v, tau, _ = hh.larfg(x)
-        xn = x - jnp.conj(tau) * v * jnp.vdot(v, x)
-        W = W.at[1:, 0].set(xn)
-        W = W.at[0, 1:].set(jnp.conj(xn))
-        W = W.at[1:, 1:].set(two_sided(tau, v, W[1:, 1:]))
+        W, v, tau = _hebr1_window(W)
         Ap = lax.dynamic_update_slice(Ap, W, (s, s))
         Vs = Vs.at[s, 0].set(v)
         taus = taus.at[s, 0].set(tau)
@@ -400,11 +419,110 @@ def _hb2st_chase(Afull: jax.Array, kd: int):
         return Ap, Vs, taus
 
     Ap, Vs, taus = lax.fori_loop(0, n_sweeps, sweep_body, (Ap, Vs0, taus0))
-    T = Ap[:n, :n]
-    idx = jnp.arange(n)
-    d = jnp.real(jnp.diagonal(T))
-    e_c = T[idx[1:], idx[:-1]]
+    d, e_c = _chase_extract(Ap, n)
     return d, e_c, Vs, taus
+
+
+def _hb2st_chase_pipelined(Afull: jax.Array, kd: int):
+    """Multi-sweep pipelined bulge chase — the reference's pass/step scheduling
+    (src/hb2st.cc:147-182: sweep s may run once sweep s-1 is two tasks ahead)
+    vectorized into batched rounds.
+
+    Static schedule: sweep s starts at round 2s and advances one chase block
+    per round, so concurrent sweeps sit exactly two blocks apart along the
+    band — far enough that their window footprints are element-disjoint (the
+    corner element of one task's diagonal window is touched by neither the
+    next sweep's off-diagonal window nor its mirror).  Each round runs one
+    (possibly inactive) hebr1 for the newly-starting sweep plus a *batched*
+    hebr2+hebr3 pair across all live fronts: (B, b, b) gathered windows,
+    batched reflectors, scattered back.  Rounds total ~2·n versus the
+    sequential chase's ~n·m steps — the same reordering of commuting tasks
+    the reference's thread scheduler performs, so the arithmetic (and the
+    reflector set) is identical up to float reassociation and tau=0 no-op
+    entries (inactive slots store zero vectors here, larfg-of-zeros there —
+    both mean H = I).
+
+    Returns (d, e_complex, Vs, taus) exactly like ``_hb2st_chase``.
+    """
+    from . import householder as hh
+
+    n = Afull.shape[-1]
+    b = kd
+    dt = Afull.dtype
+    N = n + 2 * b + 2
+    Ap = jnp.zeros((N, N), dt).at[:n, :n].set(Afull)
+    n_sweeps = max(n - 2, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    B = m_max // 2 + 2                       # slots; 2B >= m_max + 2 so a slot
+    #                                          is free before its next sweep
+    Vs0 = jnp.zeros((n_sweeps + 1, m_max, b), dt)   # +1 = dead-slot scratch row
+    taus0 = jnp.zeros((n_sweeps + 1, m_max), dt)
+    zi, zj = n + b + 1, n + 1
+    ar_b = jnp.arange(b)
+
+    def round_body(t, carry):
+        Ap, Vs, taus, s_st, r_st, vprev, tprev = carry
+
+        # ---- hebr1 for the sweep starting this round (at most one) --------
+        s0 = t // 2
+        starting = (t % 2 == 0) & (s0 < n_sweeps)
+        w0 = jnp.where(starting, s0, zj)     # redirect to zero padding if none
+        W = lax.dynamic_slice(Ap, (w0, w0), (b + 1, b + 1))
+        W, v0, tau0 = _hebr1_window(W)
+        Ap = lax.dynamic_update_slice(Ap, W, (w0, w0))
+        s0c = jnp.where(starting, s0, n_sweeps)      # scratch row when idle
+        Vs = Vs.at[s0c, 0].set(v0)
+        taus = taus.at[s0c, 0].set(tau0)
+        q0 = s0 % B
+        s_st = s_st.at[q0].set(jnp.where(starting, s0, s_st[q0]))
+        r_st = r_st.at[q0].set(jnp.where(starting, 1, r_st[q0]))
+        vprev = vprev.at[q0].set(jnp.where(starting, v0, vprev[q0]))
+        tprev = tprev.at[q0].set(jnp.where(starting, tau0, tprev[q0]))
+
+        # ---- batched hebr2+hebr3 pairs across all live fronts -------------
+        m_s = (n - 1 - s_st + b - 1) // b
+        live = (s_st >= 0) & (r_st >= 1) & (r_st < m_s)
+        i = r_st * b + 1 + s_st
+        j = (r_st - 1) * b + 1 + s_st
+        ii = jnp.where(live, i, zi)
+        jj = jnp.where(live, j, zj)
+        rows = ii[:, None] + ar_b[None, :]            # (B, b)
+        cols = jj[:, None] + ar_b[None, :]
+        Wb = Ap[rows[:, :, None], cols[:, None, :]]   # (B, b, b) gather
+        # right-apply previous reflector (bulge), then new left reflector
+        Wv = jnp.einsum("bij,bj->bi", Wb, vprev)
+        Wb = Wb - tprev[:, None, None] * Wv[:, :, None] * jnp.conj(vprev)[:, None, :]
+        v, tau, _ = hh.larfg(Wb[:, :, 0])
+        vW = jnp.einsum("bi,bij->bj", jnp.conj(v), Wb)
+        Wb = Wb - jnp.conj(tau)[:, None, None] * v[:, :, None] * vW[:, None, :]
+        Ap = Ap.at[rows[:, :, None], cols[:, None, :]].set(Wb)
+        Ap = Ap.at[cols[:, :, None], rows[:, None, :]].set(
+            jnp.conj(jnp.swapaxes(Wb, -1, -2)))
+        Db = Ap[rows[:, :, None], rows[:, None, :]]
+        Dv = jnp.einsum("bi,bij->bj", jnp.conj(v), Db)
+        Db = Db - jnp.conj(tau)[:, None, None] * v[:, :, None] * Dv[:, None, :]
+        Dw = jnp.einsum("bij,bj->bi", Db, v)
+        Db = Db - tau[:, None, None] * Dw[:, :, None] * jnp.conj(v)[:, None, :]
+        Ap = Ap.at[rows[:, :, None], rows[:, None, :]].set(Db)
+        # store reflectors (dead slots target the scratch row)
+        s_c = jnp.where(live, s_st, n_sweeps)
+        r_c = jnp.where(live, r_st, 0)
+        Vs = Vs.at[s_c, r_c].set(jnp.where(live[:, None], v, Vs[s_c, r_c]))
+        taus = taus.at[s_c, r_c].set(jnp.where(live, tau, taus[s_c, r_c]))
+        r_st = jnp.where(live, r_st + 1, r_st)
+        vprev = jnp.where(live[:, None], v, vprev)
+        tprev = jnp.where(live, tau, tprev)
+        return Ap, Vs, taus, s_st, r_st, vprev, tprev
+
+    T = 2 * n_sweeps + m_max
+    s_st0 = jnp.full((B,), -1, jnp.int32)
+    r_st0 = jnp.zeros((B,), jnp.int32)
+    vprev0 = jnp.zeros((B, b), dt)
+    tprev0 = jnp.zeros((B,), dt)
+    Ap, Vs, taus, *_ = lax.fori_loop(
+        0, T, round_body, (Ap, Vs0, taus0, s_st0, r_st0, vprev0, tprev0))
+    d, e_c = _chase_extract(Ap, n)
+    return d, e_c, Vs[:n_sweeps], taus[:n_sweeps]
 
 
 def _hb2st_q(Vs: jax.Array, taus: jax.Array, n: int, b: int) -> jax.Array:
@@ -428,7 +546,8 @@ def _infer_bandwidth(b) -> int:
     return max(1, int(np.max(np.abs(nz[0] - nz[1]))))
 
 
-def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False):
+def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False,
+          pipeline: bool = False):
     """Stage 2: band -> real symmetric tridiagonal via bulge chasing
     (src/hb2st.cc; task kernels src/internal/internal_hebr.cc).
 
@@ -438,12 +557,19 @@ def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False)
     Returns (d, e) or (d, e, Q2) with band = Q2 T Q2^H, T = tridiag(d, e).
     Like the reference, the chase runs on one device (heev.cc:137-160 confines
     stage 2 to rank 0).
+
+    ``pipeline=True`` runs the multi-sweep batched chase (the reference's
+    pass/step concurrency, hb2st.cc:147-182): ~2n rounds instead of ~n·(n/kd)
+    sequential steps.  Worth it when per-step dispatch dominates (large n on
+    accelerators); the sequential dynamic-slice windows are faster on CPU,
+    where gathers/scatters of batched windows cost more than they save.
     """
     b_arr = as_array(band)
     if kd is None:
         kd = _infer_bandwidth(b_arr)
     if b_arr.ndim > 2:
-        fn = lambda x: hb2st(x, kd=kd, opts=opts, want_vectors=want_vectors)
+        fn = lambda x: hb2st(x, kd=kd, opts=opts, want_vectors=want_vectors,
+                             pipeline=pipeline)
         for _ in range(b_arr.ndim - 2):
             fn = jax.vmap(fn)
         return fn(b_arr)
@@ -462,7 +588,8 @@ def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False)
         symmetric_already = jnp.any(jnp.abs(lower) > 0) & jnp.any(jnp.abs(upper) > 0)
         full = jnp.where(symmetric_already, both,
                          jnp.where(have_lower, full_from_lower, full_from_upper))
-        d, e_c, Vs, taus = _hb2st_chase(full, kd)
+        chase = _hb2st_chase_pipelined if pipeline else _hb2st_chase
+        d, e_c, Vs, taus = chase(full, kd)
         e = jnp.abs(e_c)
         if not want_vectors:
             return d, e

@@ -77,7 +77,8 @@ def _ge2tb_dist_fn(mesh, m: int, n: int, nb: int, dtype_str: str):
 
 
 def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
-                     want_vectors: bool = True, method_eig: str = "qr"):
+                     want_vectors: bool = True, method_eig: str = "qr",
+                     chase_pipeline: bool = False):
     """Distributed Hermitian eigensolve over the (p, q) mesh (src/heev.cc).
 
     Returns (ascending eigenvalues, Z or None); Z comes back sharded on the
@@ -101,7 +102,8 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band, Vs, Ts = _he2hb_dist_fn(grid.mesh, n, nb, str(a.dtype))(a)
     # he2hbGather analogue: replicate the (cheap) band for the local chase
     band = jax.device_put(band, grid.replicated())
-    out = hb2st(band, kd=nb, want_vectors=want_vectors)
+    out = hb2st(band, kd=nb, want_vectors=want_vectors,
+                pipeline=chase_pipeline)
     if not want_vectors:
         d, e = out
         # values-only always takes sterf — D&C inherently carries vectors
