@@ -87,7 +87,8 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
         lam, z = heev_distributed(
             a, grid, nb=default_band_nb(n, opts),
             want_vectors=want_vectors,
-            method_eig="dc" if opts.method_eig == MethodEig.DC else "qr")
+            method_eig="dc" if opts.method_eig == MethodEig.DC else "qr",
+            chase_pipeline=chase_pipeline)
         return (lam, z) if want_vectors else (lam, None)
     if method == "two_stage" and n < 8:
         method = "fused"  # no meaningful band structure below one panel

@@ -218,18 +218,28 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     Returns ``(LU, perm, info)`` with ``A[perm] = L @ U`` (L unit-lower, U
     upper, packed into one sharded array) — the distributed form of
     ``linalg.lu.getrf_tntpiv`` and the analogue of ``src/getrf_tntpiv.cc``.
+
+    Tall inputs (m > n) embed into one npad-square problem (appended unit
+    columns + the usual identity tail): pivot selection in the first n panels
+    never sees the appended columns (they are zero in every real column), so
+    ``LU[:, :n]`` and the length-m ``perm`` are exactly the tall
+    factorization.  The embedding costs O(m^3) instead of O(m n^2), so
+    *callers* should route very tall panels elsewhere (the driver dispatch
+    guards at m <= 2n); wide inputs (m < n) have no mesh kernel.
     """
-    n = A.shape[-1]
-    slate_assert(A.ndim == 2 and A.shape[0] == A.shape[1],
-                 "getrf_distributed expects a square matrix")
+    m, n = A.shape[-2:]
+    slate_assert(A.ndim == 2 and m >= n,
+                 "getrf_distributed expects a square or tall matrix")
     # clamp the block size so the padding unit never dwarfs the problem
     # (default nb=256 on a small matrix would otherwise pad to nb*lcm(p,q))
     nb = max(1, min(nb, n))
     unit = nb * _lcm(grid.p, grid.q)
-    npad = ceil_mult(n, unit)
+    npad = ceil_mult(m, unit)
     if npad > n:
+        # one allocation covers both the tall embedding (cols n..m) and the
+        # divisibility padding (rows/cols m..npad): unit diagonal throughout
         Ap = jnp.zeros((npad, npad), A.dtype)
-        Ap = Ap.at[:n, :n].set(A)
+        Ap = Ap.at[:m, :n].set(A)
         idx = jnp.arange(n, npad)
         Ap = Ap.at[idx, idx].set(1)
     else:
@@ -237,19 +247,22 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     Ap = jax.device_put(Ap, grid.spec())
     LU, perm, info = _getrf_dist_fn(grid.mesh, npad, min(nb, npad),
                                     str(Ap.dtype))(Ap)
-    if npad > n:
+    if npad > m:
         # pad rows never win a tournament against real rows (their entries in
         # real columns are zero) — except when a trailing block is exactly
         # singular, where a zero pad row can tie and be selected.  Repair the
-        # truncated perm so it remains a permutation of [0,n): out-of-range
+        # truncated perm so it remains a permutation of [0,m): out-of-range
         # entries are replaced, in position order, by the unused values that
-        # were displaced past position n (only reachable when info != 0).
-        LU, head = LU[:n, :n], perm[:n]
-        bad = head >= n
-        tail = perm[n:]
-        repl = jnp.sort(jnp.where(tail < n, tail, npad))   # unused values first
+        # were displaced past position m (only reachable when info != 0).
+        head = perm[:m]
+        bad = head >= m
+        tail = perm[m:]
+        repl = jnp.sort(jnp.where(tail < m, tail, npad))   # unused values first
         perm = jnp.where(bad, repl[jnp.cumsum(bad) - 1], head)
-        info = jnp.where(info > n, jnp.int32(0), info)
+    else:
+        perm = perm[:m]
+    LU = LU[:m, :n]
+    info = jnp.where(info > n, jnp.int32(0), info)  # pad cols never fail
     return LU, perm, info
 
 

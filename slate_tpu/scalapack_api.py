@@ -294,11 +294,15 @@ def _supports_distributed(name, args, kw) -> bool:
             m, n = n, m
         return m >= n
     if name in ("getrf", "gesv"):
-        # the mesh LU kernel is square-only; rectangular falls back
         if len(args) < 1:
             return False
         a = np.asarray(args[0])
-        return a.ndim == 2 and a.shape[0] == a.shape[1]
+        if a.ndim != 2:
+            return False
+        # factorization handles moderately tall via square embedding (the
+        # O(m^3) embedding must not dwarf the O(m n^2) job); solves need square
+        return (a.shape[0] >= a.shape[1] and a.shape[0] <= 2 * a.shape[1]) \
+            if name == "getrf" else a.shape[0] == a.shape[1]
     return True
 
 
