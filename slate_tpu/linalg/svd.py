@@ -27,7 +27,7 @@ from .qr import geqrf, unmqr
 
 
 def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
-        method: str = "fused"):
+        method: str = "fused", chase_pipeline: bool = False):
     """Singular value decomposition A = U S V^H (src/svd.cc).
 
     Returns (S descending, U or None, VT or None).  Tall/wide matrices take the QR/LQ
@@ -52,7 +52,8 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
         from ..parallel import svd_distributed
 
         S, U, VT = svd_distributed(a, grid, nb=default_band_nb(min(m, n), opts),
-                                   want_vectors=want_vectors)
+                                   want_vectors=want_vectors,
+                                   chase_pipeline=chase_pipeline)
         return S, (U if want_u else None), (VT if want_vt else None)
     if method == "two_stage":
         with trace_block("svd_two_stage", m=m, n=n):
@@ -60,7 +61,7 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
                 a, factor = _safe_scale(a)
             k = min(m, n)
             with timers.time("svd::ge2tb"):
-                d, e, U1, VT1 = ge2tb(a, opts)
+                d, e, U1, VT1 = ge2tb(a, opts, chase_pipeline=chase_pipeline)
             with timers.time("svd::bdsqr"):
                 Sv, Ub, VTb = bdsqr(d, e, opts, want_vectors=want_vectors)
             if want_vectors:
@@ -126,7 +127,8 @@ def svd_vals(A, opts=None):
 # ---------------------------------------------------------------------------
 
 
-def ge2tb(A, opts=None, nb: Optional[int] = None):
+def ge2tb(A, opts=None, nb: Optional[int] = None,
+          chase_pipeline: bool = False):
     """Full bidiagonalization: general -> real bidiagonal, as the composition of
     the reference's two stages (src/ge2tb.cc blocked band reduction, then
     src/tb2bd.cc bulge chasing) — fully jitted, no host loops (the round-1 numpy
@@ -146,7 +148,7 @@ def ge2tb(A, opts=None, nb: Optional[int] = None):
         # LQ pre-step: A^H = Q_l R  =>  A = R^H Q_l^H; bidiagonalize L = R^H
         Ql, R = jnp.linalg.qr(jnp.conj(a).T, mode="reduced")  # (n, m), (m, m)
         L = jnp.conj(R).T
-        d, e, U, VT_L = ge2tb(L, opts, nb=nb)
+        d, e, U, VT_L = ge2tb(L, opts, nb=nb, chase_pipeline=chase_pipeline)
         VT = jnp.matmul(VT_L, jnp.conj(Ql).T, precision=lax.Precision.HIGHEST)
         return d, e, U, VT
     from .eig import default_band_nb
@@ -156,7 +158,7 @@ def ge2tb(A, opts=None, nb: Optional[int] = None):
     band, Uf, Vf = ge2tb_band(a, opts, nb=nb_eff)
     if k > 2:
         d, e, U2, VT2 = tb2bd(band[..., :k, :k], nb_eff, opts,
-                              want_vectors=True)
+                              want_vectors=True, pipeline=chase_pipeline)
     else:
         # k <= 2: the band already is the bidiagonal; just normalize phases
         sq = band[:k, :k]
@@ -333,6 +335,111 @@ def _tb2bd_chase(Bfull: jax.Array, kd: int):
     return d_c, e_c, Us, tauus, Vs, tauvs
 
 
+def _tb2bd_chase_pipelined(Bfull: jax.Array, kd: int):
+    """Multi-sweep pipelined bidiagonal chase — the reference's pass/step
+    scheduling (src/tb2bd.cc:163-196, same dependency rule as hb2st)
+    vectorized into batched rounds, mirroring ``eig._hb2st_chase_pipelined``.
+
+    Sweep s starts at round 2s and advances one chase block per round, so
+    concurrent sweeps sit two blocks apart — element-disjoint window
+    footprints (the nonsymmetric band has no mirror writes, so only the
+    gebr2/gebr3 windows themselves need checking).  Each round: one scalar
+    gebr1 for the starting sweep, then batched gebr2+gebr3 pairs across all
+    live fronts.  Results match the sequential chase up to float
+    reassociation and tau=0 no-op entries.
+    """
+    from . import householder as hh
+
+    n = Bfull.shape[-1]
+    b = kd
+    dt = Bfull.dtype
+    N = n + 2 * b + 2
+    Bp = jnp.zeros((N, N), dt).at[:n, :n].set(Bfull)
+    n_sweeps = max(n - 1, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    B_slots = m_max // 2 + 2
+    Us0 = jnp.zeros((n_sweeps + 1, m_max, b), dt)    # +1 = dead-slot scratch
+    tauus0 = jnp.zeros((n_sweeps + 1, m_max), dt)
+    Vs0 = jnp.zeros((n_sweeps + 1, m_max, b), dt)
+    tauvs0 = jnp.zeros((n_sweeps + 1, m_max), dt)
+    zi, zj = n + b + 1, n + 1
+    ar_b = jnp.arange(b)
+
+    def round_body(t, carry):
+        Bp, Us, tauus, Vs, tauvs, s_st, r_st, uprev, tuprev = carry
+
+        # ---- gebr1 for the sweep starting this round (at most one) --------
+        s0 = t // 2
+        starting = (t % 2 == 0) & (s0 < n_sweeps)
+        w0 = jnp.where(starting, s0, zj)
+        W = lax.dynamic_slice(Bp, (w0, w0 + 1), (b + 1, b))
+        v0, tauv0, _ = hh.larfg(jnp.conj(W[0, :]))
+        W = hh.apply_right(tauv0, v0, W)
+        u0, tauu0, _ = hh.larfg(W[1:, 0])
+        W = W.at[1:, :].set(hh.apply_left(tauu0, u0, W[1:, :]))
+        Bp = lax.dynamic_update_slice(Bp, W, (w0, w0 + 1))
+        s0c = jnp.where(starting, s0, n_sweeps)
+        Vs = Vs.at[s0c, 0].set(v0)
+        tauvs = tauvs.at[s0c, 0].set(tauv0)
+        Us = Us.at[s0c, 0].set(u0)
+        tauus = tauus.at[s0c, 0].set(tauu0)
+        q0 = s0 % B_slots
+        s_st = s_st.at[q0].set(jnp.where(starting, s0, s_st[q0]))
+        r_st = r_st.at[q0].set(jnp.where(starting, 1, r_st[q0]))
+        uprev = uprev.at[q0].set(jnp.where(starting, u0, uprev[q0]))
+        tuprev = tuprev.at[q0].set(jnp.where(starting, tauu0, tuprev[q0]))
+
+        # ---- batched gebr2+gebr3 pairs across all live fronts -------------
+        j = r_st * b + 1 + s_st
+        i = (r_st - 1) * b + 1 + s_st
+        live = (s_st >= 0) & (r_st >= 1) & (j < n)
+        ii = jnp.where(live, i, zj)
+        jj = jnp.where(live, j, zi)
+        rows_i = ii[:, None] + ar_b[None, :]
+        cols_j = jj[:, None] + ar_b[None, :]
+        # gebr2: left-apply previous u, then new right v zeroing row 0
+        Wb = Bp[rows_i[:, :, None], cols_j[:, None, :]]   # (B, b, b)
+        uW = jnp.einsum("bi,bij->bj", jnp.conj(uprev), Wb)
+        Wb = Wb - jnp.conj(tuprev)[:, None, None] * uprev[:, :, None] * uW[:, None, :]
+        v, tauv, _ = hh.larfg(jnp.conj(Wb[:, 0, :]))
+        Wv = jnp.einsum("bij,bj->bi", Wb, v)
+        Wb = Wb - tauv[:, None, None] * Wv[:, :, None] * jnp.conj(v)[:, None, :]
+        Bp = Bp.at[rows_i[:, :, None], cols_j[:, None, :]].set(Wb)
+        # gebr3: right-apply v on the diagonal window, new left u zeroing col 0
+        Db = Bp[cols_j[:, :, None], cols_j[:, None, :]]
+        Dv = jnp.einsum("bij,bj->bi", Db, v)
+        Db = Db - tauv[:, None, None] * Dv[:, :, None] * jnp.conj(v)[:, None, :]
+        u, tauu, _ = hh.larfg(Db[:, :, 0])
+        uD = jnp.einsum("bi,bij->bj", jnp.conj(u), Db)
+        Db = Db - jnp.conj(tauu)[:, None, None] * u[:, :, None] * uD[:, None, :]
+        Bp = Bp.at[cols_j[:, :, None], cols_j[:, None, :]].set(Db)
+        # store reflectors (dead slots target the scratch row)
+        s_c = jnp.where(live, s_st, n_sweeps)
+        r_c = jnp.where(live, r_st, 0)
+        Vs = Vs.at[s_c, r_c].set(jnp.where(live[:, None], v, Vs[s_c, r_c]))
+        tauvs = tauvs.at[s_c, r_c].set(jnp.where(live, tauv, tauvs[s_c, r_c]))
+        Us = Us.at[s_c, r_c].set(jnp.where(live[:, None], u, Us[s_c, r_c]))
+        tauus = tauus.at[s_c, r_c].set(jnp.where(live, tauu, tauus[s_c, r_c]))
+        r_st = jnp.where(live, r_st + 1, r_st)
+        uprev = jnp.where(live[:, None], u, uprev)
+        tuprev = jnp.where(live, tauu, tuprev)
+        return Bp, Us, tauus, Vs, tauvs, s_st, r_st, uprev, tuprev
+
+    T = 2 * n_sweeps + m_max
+    s_st0 = jnp.full((B_slots,), -1, jnp.int32)
+    r_st0 = jnp.zeros((B_slots,), jnp.int32)
+    uprev0 = jnp.zeros((B_slots, b), dt)
+    tuprev0 = jnp.zeros((B_slots,), dt)
+    Bp, Us, tauus, Vs, tauvs, *_ = lax.fori_loop(
+        0, T, round_body,
+        (Bp, Us0, tauus0, Vs0, tauvs0, s_st0, r_st0, uprev0, tuprev0))
+    Bm = Bp[:n, :n]
+    idx = jnp.arange(n)
+    d_c = Bm[idx, idx]
+    e_c = Bm[idx[:-1], idx[1:]] if n > 1 else jnp.zeros((0,), dt)
+    return d_c, e_c, Us[:n_sweeps], tauus[:n_sweeps], Vs[:n_sweeps], tauvs[:n_sweeps]
+
+
 def _bidiag_phases(d_c, e_c, dt):
     """Unitary diagonal phases (pu, pw) with B_c = diag(pu) B_real diag(pw)^H:
     pu_j conj(pw_j) = phase(d_j), pu_j conj(pw_{j+1}) = phase(e_j)."""
@@ -349,19 +456,25 @@ def _bidiag_phases(d_c, e_c, dt):
     return pu, pw
 
 
-def tb2bd(band, kd, opts=None, want_vectors: bool = False):
+def tb2bd(band, kd, opts=None, want_vectors: bool = False,
+          pipeline: bool = False):
     """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc; kernels
     src/internal/internal_gebr.cc).  For kd=1 this is the (phase-normalized)
     identity extraction; kd >= 2 runs the real windowed chase.
 
-    With want_vectors, returns (d, e, U2, VT2) such that band = U2 B VT2."""
+    With want_vectors, returns (d, e, U2, VT2) such that band = U2 B VT2.
+    ``pipeline=True`` runs the multi-sweep batched chase (~2n rounds instead
+    of ~n*(n/kd) steps — same trade-off as ``hb2st(pipeline=True)``: wins on
+    accelerators where per-step dispatch dominates, loses to the sequential
+    dynamic-slice windows on CPU)."""
     from . import householder as hh
 
     b = as_array(band)
     if kd > 1:
         kb = min(b.shape[-2:])
         sq = b[..., :kb, :kb]
-        d_c, e_c, Us, tauus, Vs, tauvs = _tb2bd_chase(sq, kd)
+        chase = _tb2bd_chase_pipelined if pipeline else _tb2bd_chase
+        d_c, e_c, Us, tauus, Vs, tauvs = chase(sq, kd)
         pu, pw = _bidiag_phases(d_c, e_c, b.dtype)
         d, e = jnp.abs(d_c), jnp.abs(e_c)
         if not want_vectors:

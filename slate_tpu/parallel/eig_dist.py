@@ -121,7 +121,7 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
 
 
 def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
-                    want_vectors: bool = True):
+                    want_vectors: bool = True, chase_pipeline: bool = False):
     """Distributed SVD over the (p, q) mesh (src/svd.cc pipeline).
 
     Returns (S descending, U or None, VT or None); U/VT come back sharded.
@@ -141,7 +141,8 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         return out[0], None, None
     if m < n:
         S, V, UT = svd_distributed(jnp.conj(A).T, grid, nb=nb,
-                                   want_vectors=want_vectors)
+                                   want_vectors=want_vectors,
+                                   chase_pipeline=chase_pipeline)
         if not want_vectors:
             return S, None, None
         return S, jnp.conj(UT).T, jnp.conj(V).T
@@ -153,7 +154,8 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band = jax.device_put(band, grid.replicated())
     sq = band[:k, :k]
     if k > 2:
-        out = tb2bd(sq, nb, want_vectors=want_vectors)
+        out = tb2bd(sq, nb, want_vectors=want_vectors,
+                    pipeline=chase_pipeline)
         d, e = out[0], out[1]
         U2, VT2 = (out[2], out[3]) if want_vectors else (None, None)
     else:

@@ -259,10 +259,18 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
         tail = perm[m:]
         repl = jnp.sort(jnp.where(tail < m, tail, npad))   # unused values first
         perm = jnp.where(bad, repl[jnp.cumsum(bad) - 1], head)
+        # a repaired position means a pad row's (zero) L entries landed inside
+        # the leading m rows — the factorization there is NOT a clean LU of A,
+        # so a pad-column info must not be silenced into success
+        fallback = jnp.where(jnp.any(bad), jnp.argmax(bad).astype(jnp.int32) + 1,
+                             jnp.int32(0))
+        info = jnp.where(info > n, fallback, info)
     else:
         perm = perm[:m]
+        # rows n..m of the embedding columns are real rows, so pivoting there
+        # cannot corrupt the leading n columns: pad-column info is benign
+        info = jnp.where(info > n, jnp.int32(0), info)
     LU = LU[:m, :n]
-    info = jnp.where(info > n, jnp.int32(0), info)  # pad cols never fail
     return LU, perm, info
 
 

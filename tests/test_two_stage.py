@@ -252,3 +252,32 @@ class TestPipelinedChase:
         T = np.diag(d) + np.diag(e, -1) + np.diag(e, 1)
         np.testing.assert_allclose(Q2 @ T @ Q2.conj().T, np.asarray(band),
                                    atol=5e-4)
+
+
+class TestPipelinedBidiagChase:
+    """Multi-sweep batched tb2bd chase must match the sequential chase."""
+
+    @pytest.mark.parametrize("n,kd", [(16, 3), (32, 4), (40, 8)])
+    def test_matches_sequential(self, n, kd):
+        a = rng(n + 700).standard_normal((n, n)).astype(np.float32)
+        band, _, _ = slate.ge2tb_band(jnp.asarray(a), nb=kd)
+        d1, e1 = slate.tb2bd(band, kd=kd)
+        d2, e2, U2, VT2 = slate.tb2bd(band, kd=kd, want_vectors=True,
+                                      pipeline=True)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=3e-4)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=3e-4)
+        B = np.diag(np.asarray(d2)) + np.diag(np.asarray(e2), 1)
+        np.testing.assert_allclose(np.asarray(U2) @ B @ np.asarray(VT2),
+                                   np.asarray(band), atol=3e-4)
+
+    def test_complex_pipelined(self):
+        n, kd = 20, 4
+        r = rng(701)
+        a = (r.standard_normal((n, n)) + 1j * r.standard_normal((n, n))
+             ).astype(np.complex64)
+        band, _, _ = slate.ge2tb_band(jnp.asarray(a), nb=kd)
+        d, e, U2, VT2 = map(np.asarray,
+                            slate.tb2bd(band, kd=kd, want_vectors=True,
+                                        pipeline=True))
+        B = np.diag(d) + np.diag(e, 1)
+        np.testing.assert_allclose(U2 @ B @ VT2, np.asarray(band), atol=5e-4)
