@@ -16,6 +16,9 @@ from .collectives import (axis_bcast, axis_allreduce, axis_reduce_scatter, ring_
 from .distribute import (block_spec, distribute, replicate, redistribute,
                          cyclic_to_blocked, blocked_to_cyclic, cyclic_permutation)
 from .summa import gemm_distributed, gemm_allgather, gemm_ring, summa_gemm
+from .blas3_dist import (herk_distributed, syrk_distributed, her2k_distributed,
+                         syr2k_distributed, hemm_distributed, symm_distributed,
+                         trmm_distributed)
 from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
                       cholqr_distributed, gels_cholqr_distributed)
 from .lu_dist import (getrf_distributed, getrs_distributed, gesv_distributed)

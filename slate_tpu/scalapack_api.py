@@ -88,14 +88,14 @@ def _jnp(x):
     return jax.numpy.asarray(x)
 
 
-def _sym_full(uplo, a):
-    """Full Hermitian array from the stored triangle (fromScaLAPACK builds the
-    SLATE HermitianMatrix the same way)."""
+def _sym_full(uplo, a, herm: bool = True):
+    """Full Hermitian/symmetric array from the stored triangle (fromScaLAPACK
+    builds the SLATE HermitianMatrix the same way)."""
     if uplo.lower().startswith("l"):
         lo = np.tril(a, -1)
-        return np.diag(np.diagonal(a)) + lo + lo.conj().T
+        return np.diag(np.diagonal(a)) + lo + (lo.conj().T if herm else lo.T)
     up = np.triu(a, 1)
-    return np.diag(np.diagonal(a)) + up + up.conj().T
+    return np.diag(np.diagonal(a)) + up + (up.conj().T if herm else up.T)
 
 
 def _finite_info(x) -> int:
@@ -244,6 +244,71 @@ def _planhe_distributed(dt, norm, uplo, a):
     return float(norm_distributed(_norm_kind(norm), _jnp(full), _grid))
 
 
+def _pherk_distributed(dt, uplo, trans, alpha, a, beta, c, *, sy=False,
+                       two=False, b=None):
+    from .parallel import (her2k_distributed, herk_distributed,
+                           syr2k_distributed, syrk_distributed)
+
+    A = np.asarray(a, dtype=dt)
+    C = np.asarray(c, dtype=dt)
+    tl = str(trans).lower()
+    if tl in ("t", "c"):
+        A = A.conj().T if tl == "c" else A.T
+    u = "lower" if uplo.lower().startswith("l") else "upper"
+    if two:
+        B = np.asarray(b, dtype=dt)
+        if tl in ("t", "c"):
+            B = B.conj().T if tl == "c" else B.T
+        fn = syr2k_distributed if sy else her2k_distributed
+        out = np.asarray(fn(alpha, _jnp(A), _jnp(B), beta, _jnp(C), _grid,
+                            uplo=u))
+    else:
+        fn = syrk_distributed if sy else herk_distributed
+        out = np.asarray(fn(alpha, _jnp(A), beta, _jnp(C), _grid, uplo=u))
+    # mirror the stored triangle: the lapack_api p-routines return
+    # full_array() of the Hermitian result, so the distributed path matches
+    return _sym_full(uplo, out, herm=not sy)
+
+
+def _psyrk_distributed(dt, uplo, trans, alpha, a, beta, c):
+    return _pherk_distributed(dt, uplo, trans, alpha, a, beta, c, sy=True)
+
+
+def _pher2k_distributed(dt, uplo, trans, alpha, a, b, beta, c):
+    return _pherk_distributed(dt, uplo, trans, alpha, a, beta, c, two=True, b=b)
+
+
+def _psyr2k_distributed(dt, uplo, trans, alpha, a, b, beta, c):
+    return _pherk_distributed(dt, uplo, trans, alpha, a, beta, c, sy=True,
+                              two=True, b=b)
+
+
+def _phemm_distributed(dt, side, uplo, alpha, a, b, beta, c, *, sy=False):
+    from .parallel import hemm_distributed
+
+    u = "lower" if uplo.lower().startswith("l") else "upper"
+    out = hemm_distributed(side, alpha, _jnp(np.asarray(a, dtype=dt)),
+                           _jnp(np.asarray(b, dtype=dt)), beta,
+                           _jnp(np.asarray(c, dtype=dt)), _grid, uplo=u,
+                           herm=not sy)
+    return np.asarray(out)
+
+
+def _psymm_distributed(dt, side, uplo, alpha, a, b, beta, c):
+    return _phemm_distributed(dt, side, uplo, alpha, a, b, beta, c, sy=True)
+
+
+def _ptrmm_distributed(dt, side, uplo, transa, diag, alpha, a, b):
+    from .parallel import trmm_distributed
+
+    u = "lower" if uplo.lower().startswith("l") else "upper"
+    out = trmm_distributed(side, alpha, _jnp(np.asarray(a, dtype=dt)),
+                           _jnp(np.asarray(b, dtype=dt)), _grid, uplo=u,
+                           conj_trans=str(transa).lower() in ("t", "c"),
+                           unit_diag=str(diag).lower().startswith("u"))
+    return np.asarray(out)
+
+
 def _norm_kind(norm):
     """Resolve a LAPACK norm character through the shared Norm enum — unknown
     characters raise exactly like the single-device fallback path."""
@@ -271,6 +336,13 @@ _DISTRIBUTED = {
     "lange": _plange_distributed,
     "lanhe": _planhe_distributed,
     "lansy": _planhe_distributed,
+    "herk": _pherk_distributed,
+    "syrk": _psyrk_distributed,
+    "her2k": _pher2k_distributed,
+    "syr2k": _psyr2k_distributed,
+    "hemm": _phemm_distributed,
+    "symm": _psymm_distributed,
+    "trmm": _ptrmm_distributed,
 }
 
 
@@ -285,6 +357,10 @@ def _supports_distributed(name, args, kw) -> bool:
         # plain transpose of a complex triangle has no mesh kernel (the
         # distributed solve implements conjugate-transpose)
         return not (str(args[2]).lower() == "t" and np.iscomplexobj(args[5]))
+    if name == "trmm":
+        # same restriction: the mesh kernel's trans is conjugate-transpose
+        return not (len(args) >= 7 and str(args[2]).lower() == "t"
+                    and np.iscomplexobj(args[5]))
     if name == "gels":
         if len(args) < 2:
             return False
