@@ -337,6 +337,22 @@ class BaseMatrix:
                 f"mt={self.mt}, nt={self.nt}, op={self.op}{extra}, dtype={self.dtype})")
 
 
+def tri_to_full(a: jax.Array, lower: bool, herm: bool) -> jax.Array:
+    """Full symmetric/Hermitian array from the stored triangle (jit-safe,
+    batch-dim aware).  The Hermitian case real-casts the diagonal — BLAS
+    her* semantics ignore the imaginary part of a Hermitian diagonal."""
+    strict = jnp.tril(a, -1) if lower else jnp.triu(a, 1)
+    mirror = jnp.swapaxes(strict, -1, -2)
+    if herm and jnp.iscomplexobj(a):
+        mirror = jnp.conj(mirror)
+        diag = jnp.real(jnp.diagonal(a, axis1=-2, axis2=-1)).astype(a.dtype)
+    else:
+        diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    full = strict + mirror
+    idx = jnp.arange(a.shape[-1])
+    return full.at[..., idx, idx].set(diag)
+
+
 def _flip_uplo(uplo: Uplo) -> Uplo:
     if uplo == Uplo.Lower:
         return Uplo.Upper
@@ -442,12 +458,7 @@ class SymmetricMatrix(BaseTrapezoidMatrix):
 
     def full_array(self) -> jax.Array:
         """Symmetrize from the stored triangle: A = tril(A) + tril(A,-1)^T etc."""
-        a = self.array
-        if self.uplo == Uplo.Lower:
-            lower = jnp.tril(a)
-            return lower + jnp.swapaxes(jnp.tril(a, -1), -1, -2)
-        upper = jnp.triu(a)
-        return upper + jnp.swapaxes(jnp.triu(a, 1), -1, -2)
+        return tri_to_full(self.array, self.uplo == Uplo.Lower, herm=False)
 
 
 class HermitianMatrix(BaseTrapezoidMatrix):
@@ -457,21 +468,7 @@ class HermitianMatrix(BaseTrapezoidMatrix):
         super().__init__(uplo, n, n, nb, *args, **kw)
 
     def full_array(self) -> jax.Array:
-        a = self.array
-        if self.uplo == Uplo.Lower:
-            strict = jnp.tril(a, -1)
-            diag = jnp.diagonal(a, axis1=-2, axis2=-1)
-        else:
-            strict = jnp.triu(a, 1)
-            diag = jnp.diagonal(a, axis1=-2, axis2=-1)
-        if jnp.iscomplexobj(a):
-            diag = jnp.real(diag).astype(a.dtype)
-            herm = jnp.conj(jnp.swapaxes(strict, -1, -2))
-        else:
-            herm = jnp.swapaxes(strict, -1, -2)
-        full = strict + herm
-        idx = jnp.arange(a.shape[-1])
-        return full.at[..., idx, idx].set(diag)
+        return tri_to_full(self.array, self.uplo == Uplo.Lower, herm=True)
 
 
 class BaseBandMatrix(BaseMatrix):

@@ -153,16 +153,9 @@ def _hemm_fn(mesh, left: bool, lower: bool, herm: bool):
     spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
 
     def fn(a, b, c, alpha, beta):
-        # full operand from the stored triangle: strict triangle mirrored,
-        # diagonal kept (real for the Hermitian case)
-        tri = jnp.tril(a) if lower else jnp.triu(a)
-        strict = jnp.tril(a, -1) if lower else jnp.triu(a, 1)
-        refl = jnp.conj(strict.T) if herm else strict.T
-        full = tri + refl
-        if herm:
-            d = jnp.real(jnp.diagonal(full))
-            full = full.at[jnp.arange(a.shape[0]),
-                           jnp.arange(a.shape[0])].set(d.astype(full.dtype))
+        from ..core.matrix import tri_to_full
+
+        full = tri_to_full(a, lower, herm)
         prod = (jnp.matmul(full, b, precision=_PREC) if left
                 else jnp.matmul(b, full, precision=_PREC))
         out = alpha * prod + beta * c

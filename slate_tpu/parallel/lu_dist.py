@@ -225,11 +225,23 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     ``LU[:, :n]`` and the length-m ``perm`` are exactly the tall
     factorization.  The embedding costs O(m^3) instead of O(m n^2), so
     *callers* should route very tall panels elsewhere (the driver dispatch
-    guards at m <= 2n); wide inputs (m < n) have no mesh kernel.
+    guards at m <= 2n).
+
+    Wide inputs (m < n) factor the leading m×m block — partial pivoting never
+    looks past column m — and finish the trailing columns with one sharded
+    unit-lower solve, U[:, m:] = L^{-1} (P A)[:, m:] (the same split the
+    reference's getrf uses once the diagonal runs out).
     """
     m, n = A.shape[-2:]
-    slate_assert(A.ndim == 2 and m >= n,
-                 "getrf_distributed expects a square or tall matrix")
+    slate_assert(A.ndim == 2, "getrf_distributed expects a 2-D matrix")
+    if m < n:
+        from .solvers import trsm_distributed
+
+        LU1, perm, info = getrf_distributed(A[:, :m], grid, nb=nb)
+        L = jnp.tril(LU1, -1) + jnp.eye(m, dtype=LU1.dtype)
+        U2 = trsm_distributed(L, jnp.take(A[:, m:], perm, axis=0), grid,
+                              lower=True, conj_trans=False)
+        return jnp.concatenate([LU1, U2], axis=1), perm, info
     # clamp the block size so the padding unit never dwarfs the problem
     # (default nb=256 on a small matrix would otherwise pad to nb*lcm(p,q))
     nb = max(1, min(nb, n))

@@ -90,12 +90,17 @@ def _jnp(x):
 
 def _sym_full(uplo, a, herm: bool = True):
     """Full Hermitian/symmetric array from the stored triangle (fromScaLAPACK
-    builds the SLATE HermitianMatrix the same way)."""
+    builds the SLATE HermitianMatrix the same way).  The Hermitian case
+    real-casts the diagonal, matching HermitianMatrix.full_array() and BLAS
+    herk semantics (the imaginary part of a Hermitian diagonal is ignored)."""
+    d = np.diagonal(a)
+    if herm and np.iscomplexobj(a):
+        d = np.real(d).astype(a.dtype)
     if uplo.lower().startswith("l"):
         lo = np.tril(a, -1)
-        return np.diag(np.diagonal(a)) + lo + (lo.conj().T if herm else lo.T)
+        return np.diag(d) + lo + (lo.conj().T if herm else lo.T)
     up = np.triu(a, 1)
-    return np.diag(np.diagonal(a)) + up + (up.conj().T if herm else up.T)
+    return np.diag(d) + up + (up.conj().T if herm else up.T)
 
 
 def _finite_info(x) -> int:
@@ -237,11 +242,17 @@ def _plange_distributed(dt, norm, a):
                                   _jnp(np.asarray(a, dtype=dt)), _grid))
 
 
-def _planhe_distributed(dt, norm, uplo, a):
+def _planhe_distributed(dt, norm, uplo, a, *, herm=True):
     from .parallel import norm_distributed
 
-    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    full = _sym_full(uplo, np.asarray(a, dtype=dt), herm=herm)
     return float(norm_distributed(_norm_kind(norm), _jnp(full), _grid))
+
+
+def _plansy_distributed(dt, norm, uplo, a):
+    # symmetric (not Hermitian) mirror: a complex diagonal keeps its imaginary
+    # part — real-casting it would change one/inf/fro norms for zlansy
+    return _planhe_distributed(dt, norm, uplo, a, herm=False)
 
 
 def _pherk_distributed(dt, uplo, trans, alpha, a, beta, c, *, sy=False,
@@ -335,7 +346,7 @@ _DISTRIBUTED = {
     "gesvd": _pgesvd_distributed,
     "lange": _plange_distributed,
     "lanhe": _planhe_distributed,
-    "lansy": _planhe_distributed,
+    "lansy": _plansy_distributed,
     "herk": _pherk_distributed,
     "syrk": _psyrk_distributed,
     "her2k": _pher2k_distributed,
@@ -375,9 +386,10 @@ def _supports_distributed(name, args, kw) -> bool:
         a = np.asarray(args[0])
         if a.ndim != 2:
             return False
-        # factorization handles moderately tall via square embedding (the
-        # O(m^3) embedding must not dwarf the O(m n^2) job); solves need square
-        return (a.shape[0] >= a.shape[1] and a.shape[0] <= 2 * a.shape[1]) \
+        # factorization handles wide directly and moderately tall via square
+        # embedding (the O(m^3) embedding must not dwarf the O(m n^2) job);
+        # solves need square
+        return a.shape[0] <= 2 * a.shape[1] \
             if name == "getrf" else a.shape[0] == a.shape[1]
     return True
 

@@ -345,6 +345,23 @@ class TestTallDistributedLU:
             U = np.triu(LU[:n, :n])
             assert np.abs(a[perm] - L @ U).max() < 1e-4
 
+    def test_wide_factorization(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from slate_tpu.parallel import ProcessGrid, getrf_distributed
+
+        r = np.random.default_rng(2)
+        grid = ProcessGrid(2, 4)
+        for m, n in [(64, 96), (30, 100)]:
+            a = r.standard_normal((m, n)).astype(np.float32)
+            LU, perm, info = getrf_distributed(jnp.asarray(a), grid, nb=16)
+            LU, perm = np.asarray(LU), np.asarray(perm)
+            assert int(info) == 0
+            assert sorted(perm.tolist()) == list(range(m))
+            L = np.tril(LU[:, :m], -1) + np.eye(m, dtype=np.float32)
+            U = np.triu(LU)
+            assert np.abs(a[perm] - L @ U).max() < 1e-4
+
     def test_tall_wrapper_routes(self):
         import numpy as np
         import jax.numpy as jnp
