@@ -243,10 +243,13 @@ def child_gels(cpu_fallback):
     import slate_tpu
 
     def body(i, bc, a):
-        # the framework's CholeskyQR2 least-squares path (linalg/qr.py
-        # gels_cholqr — fully jittable since the lax.cond restructure);
-        # the carry perturbs b so the tunnel cannot memoize iterations
-        X = slate_tpu.gels_cholqr(a, bc)
+        # the framework's CSNE least-squares path (linalg/qr.py gels_cholqr).
+        # A must be perturbed by the carry: with a loop-invariant A, XLA
+        # hoists the entire O(m n^2) factorization out of the fori_loop and
+        # the chain delta times only the thin RHS solve (observed: t(k=3) -
+        # t(k=1) = 0.02 s for a 0.65 s job)
+        ap = a + 1e-7 * bc[0, 0]
+        X = slate_tpu.gels_cholqr(ap, bc)
         return bc + 1e-6 * X[0, 0]
 
     flops = 2.0 * n * n * (m - n / 3.0) + 4.0 * m * n * nrhs

@@ -222,14 +222,25 @@ def cholqr(A, opts=None):
     a = as_array(A)
     m, n = a.shape[-2:]
 
+    def q_from_chol(L, x):
+        # Q = x · L^{-H} via inverting the small n×n triangle and one MXU gemm.
+        # A right-side blocked TriangularSolve over the tall x materializes
+        # O(m·n) temps per column block inside XLA — it OOMs a single chip at
+        # the BASELINE 131072×4096 config — while the inverse is n×n and the
+        # product is a single (m,n)·(n,n) matmul (the trtri+gemm trsm shape).
+        # CholeskyQR2's second pass absorbs the extra rounding of the explicit
+        # inverse.
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype), L.shape)
+        Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+        W = jnp.conj(jnp.swapaxes(Linv, -1, -2))    # L^{-H}, upper
+        return jnp.matmul(x, W, precision=lax.Precision.HIGHEST)
+
     def one_pass(x):
         G = jnp.matmul(jnp.conj(jnp.swapaxes(x, -1, -2)), x,
                        precision=lax.Precision.HIGHEST)
         L = lax.linalg.cholesky(G)
         info = _chol_info(L)
-        Q = lax.linalg.triangular_solve(L, x, left_side=False, lower=True,
-                                        conjugate_a=True, transpose_a=True)
-        return Q, jnp.conj(jnp.swapaxes(L, -1, -2)), info
+        return q_from_chol(L, x), jnp.conj(jnp.swapaxes(L, -1, -2)), info
 
     def shifted_pass(x):
         # shifted retry (stabilized CholeskyQR): shift Gram by ~11(mn+n^2) eps ||A||^2
@@ -239,9 +250,7 @@ def cholqr(A, opts=None):
                        precision=lax.Precision.HIGHEST) + shift * jnp.eye(
                            n, dtype=x.dtype)
         L = lax.linalg.cholesky(G)
-        Q = lax.linalg.triangular_solve(L, x, left_side=False, lower=True,
-                                        conjugate_a=True, transpose_a=True)
-        return Q, jnp.conj(L.T)
+        return q_from_chol(L, x), jnp.conj(L.T)
 
     with trace_block("cholqr", m=m, n=n):
         # fully traceable (no host syncs): failure branches route through
@@ -259,6 +268,65 @@ def cholqr(A, opts=None):
                         lambda _: lax.linalg.qr(a, full_matrices=False),
                         lambda _: (Q2, R), None)
     return Q, R
+
+
+def _gels_csne(a, b):
+    """Overdetermined least squares by corrected semi-normal equations
+    (Björck's CSNE — the TPU-fit form of the reference's CholQR least squares,
+    src/gels_cholqr.cc): R^H R x = A^H b with R from Cholesky of the Gram
+    matrix, plus one refinement step x += (R^H R)^{-1} A^H (b - A x).
+
+    Redesign note: the reference materializes the tall Q = A R^{-1} and
+    applies Q^H to B.  On TPU that right-side triangular solve over the tall
+    operand is the memory hot spot (XLA materializes O(m·n) temps per column
+    block — it OOMs one chip at the BASELINE 131072×4096 config), and Q is
+    never needed again.  CSNE keeps the whole job as one Gram matmul plus thin
+    mat-vecs — pure MXU work, O(n²) extra memory — and the corrected step
+    restores the accuracy the squared condition number costs, to the same
+    envelope as the reference's CholQR path (which squares cond(A) in R too).
+    Rank-deficient or borderline-conditioned inputs (Cholesky of the Gram
+    fails, or the solve produces non-finite values) fall back to Householder
+    QR inside the jitted program (lax.cond), mirroring the MethodCholQR -> QR
+    fallback — and Householder is the accurate choice exactly when the
+    squared-Gram route is in trouble, so no shifted retry is attempted here.
+    """
+    ah = jnp.conj(jnp.swapaxes(a, -1, -2))
+    G = jnp.matmul(ah, a, precision=lax.Precision.HIGHEST)
+    w = jnp.matmul(ah, b, precision=lax.Precision.HIGHEST)
+    L = lax.linalg.cholesky(G)
+    info = _chol_info(L)
+
+    def normal_solve(rhs):
+        y = lax.linalg.triangular_solve(L, rhs, left_side=True, lower=True)
+        return lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                           conjugate_a=True, transpose_a=True)
+
+    x = normal_solve(w)
+    # one corrected step (the "C" in CSNE)
+    r = b - jnp.matmul(a, x, precision=lax.Precision.HIGHEST)
+    x = x + normal_solve(jnp.matmul(ah, r, precision=lax.Precision.HIGHEST))
+
+    def qr_path(_):
+        Q, R = lax.linalg.qr(a, full_matrices=False)
+        # this branch only runs when the Gram route failed, i.e. A may be
+        # numerically rank-deficient: clamp vanishing R diagonals at
+        # sqrt(eps)·max|d| so the null directions get negligible (not
+        # catastrophic) weight — full-rank borderline cases (|d| ratio down
+        # to ~1/cond > sqrt(eps)) are untouched
+        n = R.shape[-1]
+        d = jnp.diagonal(R, axis1=-2, axis2=-1)
+        tol = jnp.sqrt(jnp.finfo(R.real.dtype).eps) * jnp.max(jnp.abs(d))
+        small = jnp.abs(d) < tol
+        dc = jnp.where(small, jnp.where(jnp.real(d) < 0, -tol, tol)
+                       .astype(R.dtype), d)
+        idx = jnp.arange(n)
+        R = R.at[..., idx, idx].set(dc)
+        y = jnp.matmul(jnp.conj(jnp.swapaxes(Q, -1, -2)), b,
+                       precision=lax.Precision.HIGHEST)
+        return lax.linalg.triangular_solve(R, y, left_side=True, lower=False)
+
+    bad = (info != 0) | ~jnp.all(jnp.isfinite(x))
+    return lax.cond(bad, qr_path, lambda _: x, None)
 
 
 def gels(A, BX, opts=None):
@@ -281,15 +349,13 @@ def gels(A, BX, opts=None):
     with trace_block("gels", m=m, n=n, method=str(method)):
         if m >= n:
             if method == MethodGels.CholQR:
-                Q, R = cholqr(a, opts)
-                y = jnp.matmul(jnp.conj(jnp.swapaxes(Q, -1, -2)), b,
-                               precision=lax.Precision.HIGHEST)
+                x = _gels_csne(a, b)
             else:
                 fac = geqrf(a, opts)
                 y = unmqr("left", "c", fac, b)[..., :n, :]
                 R = fac.R()
-            x = lax.linalg.triangular_solve(R, y[..., :n, :], left_side=True,
-                                            lower=False)
+                x = lax.linalg.triangular_solve(R, y, left_side=True,
+                                                lower=False)
         else:
             # minimum-norm: A = L Q, x = Q^H L^{-1} b
             fac = gelqf(a, opts)

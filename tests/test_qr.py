@@ -92,6 +92,22 @@ def test_gels_overdetermined(rng, method):
     np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
 
 
+def test_gels_cholqr_rank_deficient_fallback(rng):
+    """Rank-deficient input: the CSNE path's Cholesky fails and the in-trace
+    Householder fallback (with clamped R diagonal) must still reach the
+    minimal residual."""
+    m, n = 60, 10
+    a = np.asarray(_gen(rng, m, n))
+    a = np.column_stack([a[:, :n - 1], a[:, 0]])   # duplicate column
+    b = np.asarray(_gen(rng, m, 2))
+    x = np.asarray(linalg.gels(jnp.asarray(a), jnp.asarray(b),
+                               {"method_gels": "cholqr"}))
+    assert np.all(np.isfinite(x))
+    res = np.linalg.norm(a @ x - b)
+    ref = np.linalg.norm(a @ np.linalg.lstsq(a, b, rcond=None)[0] - b)
+    assert res <= ref * (1 + 1e-9)
+
+
 def test_gels_underdetermined_minimum_norm(rng):
     m, n = 8, 20
     a = _gen(rng, m, n)
