@@ -117,6 +117,32 @@ def _lu_nopiv_unblocked(a):
     return lax.fori_loop(0, n, body, a)
 
 
+_LU_NOPIV_BASE = 128
+
+
+def _lu_nopiv_blocked(a):
+    """Recursive blocked LU without pivoting: factor the leading half, two
+    triangular solves, one Schur-complement MXU gemm, recurse on the trailing
+    half.  The unblocked rank-1 loop runs only at the <=128 base — at nb=2048
+    the rank-1 form alone moves ~70 GB of HBM per block (2048 sweeps over a
+    16 MB tile) and dominated the whole CALU factorization."""
+    n = a.shape[-1]
+    if n <= _LU_NOPIV_BASE:
+        return _lu_nopiv_unblocked(a)
+    h = n // 2
+    a11, a12 = a[..., :h, :h], a[..., :h, h:]
+    a21, a22 = a[..., h:, :h], a[..., h:, h:]
+    f11 = _lu_nopiv_blocked(a11)
+    u12 = lax.linalg.triangular_solve(f11, a12, left_side=True, lower=True,
+                                      unit_diagonal=True)
+    l21 = lax.linalg.triangular_solve(f11, a21, left_side=False, lower=False)
+    s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    f22 = _lu_nopiv_blocked(s)
+    return jnp.concatenate(
+        [jnp.concatenate([f11, u12], axis=-1),
+         jnp.concatenate([l21, f22], axis=-1)], axis=-2)
+
+
 @lru_cache(maxsize=32)
 def _getrf_nopiv_fn(m: int, n: int, nb: int, dtype_str: str):
     nt = -(-min(m, n) // nb)
@@ -124,7 +150,7 @@ def _getrf_nopiv_fn(m: int, n: int, nb: int, dtype_str: str):
     def fn(A):
         for k in range(nt):
             k0, k1 = k * nb, min((k + 1) * nb, min(m, n))
-            blk = _lu_nopiv_unblocked(A[k0:k1, k0:k1])
+            blk = _lu_nopiv_blocked(A[k0:k1, k0:k1])
             A = A.at[k0:k1, k0:k1].set(blk)
             if k1 < m:
                 L21 = lax.linalg.triangular_solve(
@@ -265,29 +291,48 @@ def _tournament_panel(panel, nb):
 
     Returns the winning global row indices (length min(nb, mp)).
     """
-    mp = panel.shape[0]
+    mp, w = panel.shape
     k = min(nb, mp)
-    # leaves: blocks of nb rows
-    blocks = []
-    for s in range(0, mp, nb):
-        rows = jnp.arange(s, min(s + nb, mp))
-        blocks.append((panel[s:min(s + nb, mp)], rows))
-    # reduction tree: LU each pair's stacked winners, keep top-k rows
-    while len(blocks) > 1:
-        nxt = []
-        for i in range(0, len(blocks) - 1, 2):
-            sub = jnp.concatenate([blocks[i][0], blocks[i + 1][0]], axis=0)
-            idx = jnp.concatenate([blocks[i][1], blocks[i + 1][1]])
-            _, _, perm = lax.linalg.lu(sub)
-            take = perm[: min(k, sub.shape[0])]
-            nxt.append((jnp.take(sub, take, axis=0), jnp.take(idx, take)))
-        if len(blocks) % 2 == 1:
-            nxt.append(blocks[-1])
-        blocks = nxt
-    sub, idx = blocks[0]
-    _, _, perm = lax.linalg.lu(sub)
-    take = perm[: min(k, sub.shape[0])]
-    return jnp.take(idx, take)
+    nfull = mp // nb
+    # uniform leaves (nb rows each) reduce as ONE batched LU per tree level —
+    # TPU executes ops sequentially, so the reference's independent per-pair
+    # merges must be a batch, not a Python loop of separate lu calls (this
+    # halved the measured CALU time at the n=16384 bench config)
+    if nfull >= 2:
+        V = panel[: nfull * nb].reshape(nfull, nb, w)
+        I = jnp.arange(nfull * nb).reshape(nfull, nb)
+        while V.shape[0] > 1:
+            nblk = V.shape[0]
+            half = nblk // 2
+            V2 = jnp.concatenate([V[0:2 * half:2], V[1:2 * half:2]], axis=1)
+            I2 = jnp.concatenate([I[0:2 * half:2], I[1:2 * half:2]], axis=1)
+            _, _, perm = lax.linalg.lu(V2)          # batched pair merges
+            take = perm[:, :k]
+            V2 = jnp.take_along_axis(V2, take[:, :, None], axis=1)
+            I2 = jnp.take_along_axis(I2, take, axis=1)
+            if nblk % 2:
+                V2 = jnp.concatenate([V2, V[2 * half:][:, :k]], axis=0)
+                I2 = jnp.concatenate([I2, I[2 * half:][:, :k]], axis=0)
+            V, I = V2, I2
+        sub, idx = V[0], I[0]
+        ordered = True     # the last pair merge emitted winners in pivot order
+    elif nfull == 1:
+        sub, idx = panel[:nb], jnp.arange(nb)
+        ordered = False
+    else:
+        sub, idx = panel, jnp.arange(mp)
+        ordered = False
+    rest = nfull * nb
+    if rest and rest < mp:      # ragged tail block joins the final merge
+        sub = jnp.concatenate([sub, panel[rest:]], axis=0)
+        idx = jnp.concatenate([idx, jnp.arange(rest, mp)])
+        ordered = False
+    if not ordered:
+        # root LU orders the winners (pivot order, reference's root merge);
+        # redundant — and skipped — when the tree already ordered them
+        _, _, perm = lax.linalg.lu(sub)
+        idx = jnp.take(idx, perm[: min(k, sub.shape[0])])
+    return idx[:k]
 
 
 @lru_cache(maxsize=32)
@@ -302,15 +347,27 @@ def _getrf_tntpiv_fn(m: int, n: int, nb: int, dtype_str: str):
             w = k1 - k0
             panel = A[k0:m, k0:k1]
             winners = _tournament_panel(panel, w)          # local indices into panel
-            rest_mask = jnp.ones(m - k0, dtype=bool).at[winners].set(False)
-            rest = jnp.where(rest_mask, jnp.arange(m - k0), m)  # push winners out
-            rest = jnp.sort(rest)[: m - k0 - w]
-            local = jnp.concatenate([winners, rest])
-            gperm = jnp.concatenate([jnp.arange(k0), k0 + local])
-            A = jnp.take(A, gperm, axis=0)
-            perm = jnp.take(perm, gperm)
+            # dirty-rows-only exchange (permuteRows analogue): winners move to
+            # the top w window slots and the displaced occupants fill the
+            # vacated winner slots — ≤ 2w rows move, vs the full-matrix
+            # compaction gather (4x the HBM traffic at the n=16384 bench)
+            mw = m - k0
+            ar = jnp.arange(mw)
+            is_w = jnp.zeros(mw, dtype=bool).at[winners].set(True)
+            big = mw + w                                   # OOB sentinel
+            disp = jnp.sort(jnp.where(~is_w[:w], jnp.arange(w), big))
+            vac = jnp.sort(jnp.where(is_w & (ar >= w), ar, big))[:w]
+            # window permutation: identity, winners into [:w], displaced into
+            # the vacated slots (slot i of vac pairs with slot i of disp —
+            # their valid counts match by construction)
+            gwin = ar.at[:w].set(winners).at[vac].set(disp, mode="drop")
+            S = jnp.concatenate([k0 + jnp.arange(w), k0 + vac])      # dirty dst
+            src = k0 + jnp.concatenate([winners, disp])              # their rows
+            rows = A[jnp.clip(src, 0, m - 1)]
+            A = A.at[S].set(rows, mode="drop")
+            perm = jnp.take(perm, jnp.concatenate([jnp.arange(k0), k0 + gwin]))
             # nopiv factor of the permuted panel (pivots already chosen)
-            blk = _lu_nopiv_unblocked(A[k0:k1, k0:k1])
+            blk = _lu_nopiv_blocked(A[k0:k1, k0:k1])
             A = A.at[k0:k1, k0:k1].set(blk)
             if k1 < m:
                 L21 = lax.linalg.triangular_solve(
