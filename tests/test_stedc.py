@@ -70,6 +70,24 @@ class TestStedc:
         assert np.abs(np.sort(lam) - lam_ref).max() / scale < 5e-5
         assert np.abs(Q.T @ Q - np.eye(n)).max() < 5e-3
 
+    def test_glued_wilkinson_clusters_orthogonal(self):
+        """Many-fold clusters (glued Wilkinson blocks): the gated
+        Newton-Schulz repair must hold orthogonality near eps-level; the raw
+        Loewner columns alone degrade to ~1e-3 here (the pre-repair
+        envelope)."""
+        m, k, glue = 21, 6, 1e-6
+        d1 = np.abs(np.arange(-(m // 2), m // 2 + 1)).astype(np.float32)
+        d = np.concatenate([d1] * k)
+        n = d.shape[0]
+        e = np.ones(n - 1, np.float32)
+        for i in range(1, k):
+            e[i * m - 1] = glue   # weak bond exactly at each block boundary
+        lam, Q = slate.stedc(jnp.asarray(d), jnp.asarray(e))
+        lam, Q = np.asarray(lam), np.asarray(Q)
+        T = _tri(d, e)
+        assert np.abs(Q.T @ Q - np.eye(n)).max() < 5e-5
+        assert np.abs(T @ Q - Q * lam[None, :]).max() < 2e-4
+
     def test_signed_offdiagonal(self):
         """Negative e entries: the sign similarity must fold into Q."""
         r = np.random.default_rng(4)
