@@ -309,3 +309,32 @@ def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     """
     LU, perm, info = getrf_distributed(A, grid, nb=nb)
     return getrs_distributed(LU, perm, B, grid), info
+
+
+def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
+                           nb: int = 256, max_iterations: int = 30):
+    """Distributed mixed-precision solve (src/gesv_mixed.cc over the mesh):
+    tournament-LU factor in the next precision down (f64->f32, c128->c64;
+    f32 has no lower rung — XLA's LU rejects bf16), working-precision
+    iterative refinement, full-precision sharded fallback when IR stalls.
+
+    Returns (X, perm, info, iters, converged_via_ir).
+    """
+    from .solvers import _ir_refine_distributed, _lower_dtype
+
+    lo = _lower_dtype(A.dtype)
+    if lo is None:
+        LU, perm, info = getrf_distributed(A, grid, nb=nb)
+        return getrs_distributed(LU, perm, B, grid), perm, info, 0, True
+    LU, perm, info = getrf_distributed(A.astype(lo), grid, nb=nb)
+
+    def solve_lo(R):
+        return getrs_distributed(LU, perm, R.astype(lo), grid)
+
+    X, iters, ok = _ir_refine_distributed(A, B, solve_lo, grid,
+                                          max_iterations)
+    if not ok or not bool(jnp.all(jnp.isfinite(X))):
+        LU, perm, info = getrf_distributed(A, grid, nb=nb)
+        return (getrs_distributed(LU, perm, B, grid), perm, info, iters,
+                False)
+    return X, perm, info, iters, True

@@ -173,6 +173,20 @@ def _pgesv_distributed(dt, a, b):
     return (X[:, 0] if vec else X), _la.perm_to_pivots(perm), int(info)
 
 
+def _pgesv_mixed_distributed(dt, a, b):
+    from . import linalg as _la
+    from .parallel import gesv_mixed_distributed
+
+    b = np.asarray(b, dtype=dt)
+    vec = b.ndim == 1
+    X, perm, info, iters, _ = gesv_mixed_distributed(
+        _jnp(np.asarray(a, dtype=dt)), _jnp(b[:, None] if vec else b), _grid,
+        nb=_nb())
+    X = np.asarray(X)
+    return ((X[:, 0] if vec else X), _la.perm_to_pivots(np.asarray(perm)),
+            int(info), int(iters))
+
+
 def _pgetrs_distributed(dt, trans, lu_, ipiv, b):
     from . import linalg as _la
     from .parallel import getrs_distributed
@@ -336,6 +350,7 @@ _DISTRIBUTED = {
     "posv": _pposv_distributed,
     "getrf": _pgetrf_distributed,
     "gesv": _pgesv_distributed,
+    "gesv_mixed": _pgesv_mixed_distributed,
     "getrs": _pgetrs_distributed,
     "gels": _pgels_distributed,
     "trsm": _ptrsm_distributed,
@@ -380,7 +395,7 @@ def _supports_distributed(name, args, kw) -> bool:
         if str(args[0]).lower() in ("t", "c"):
             m, n = n, m
         return m >= n
-    if name in ("getrf", "gesv"):
+    if name in ("getrf", "gesv", "gesv_mixed"):
         if len(args) < 1:
             return False
         a = np.asarray(args[0])

@@ -345,6 +345,32 @@ class TestTallDistributedLU:
             U = np.triu(LU[:n, :n])
             assert np.abs(a[perm] - L @ U).max() < 1e-4
 
+    def test_mixed_precision_distributed(self):
+        """f32-factor + f64-refine over the mesh (gesv_mixed.cc / posv_mixed.cc
+        analogue): IR must reach working-precision accuracy from the low
+        factor."""
+        import numpy as np
+        import jax.numpy as jnp
+        from slate_tpu.parallel import (ProcessGrid, gesv_mixed_distributed,
+                                        posv_mixed_distributed)
+
+        r = np.random.default_rng(9)
+        grid = ProcessGrid(2, 4)
+        n, nrhs = 64, 4
+        m = r.standard_normal((n, n))
+        Af = jnp.asarray(m @ m.T + n * np.eye(n))
+        B = jnp.asarray(r.standard_normal((n, nrhs)))
+        X, iters, ok = posv_mixed_distributed(Af, B, grid, nb=16)
+        res = np.linalg.norm(np.asarray(Af) @ np.asarray(X) - np.asarray(B))
+        assert ok and res / np.linalg.norm(np.asarray(B)) < 1e-12
+
+        G = jnp.asarray(r.standard_normal((n, n)))
+        X2, perm, info, it2, ok2 = gesv_mixed_distributed(G, B, grid, nb=16)
+        assert ok2 and int(info) == 0
+        assert sorted(np.asarray(perm).tolist()) == list(range(n))
+        res2 = np.linalg.norm(np.asarray(G) @ np.asarray(X2) - np.asarray(B))
+        assert res2 / np.linalg.norm(np.asarray(B)) < 1e-12
+
     def test_wide_factorization(self):
         import numpy as np
         import jax.numpy as jnp
