@@ -21,7 +21,8 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
                    Uplo, func)
 
 from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
-                   scale_row_col, set, symm, syr2k, syrk, trmm, trsm)
+                   scale_row_col, set, set_from_function, set_lambdas, symm,
+                   syr2k, syrk, trmm, trsm)
 from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band, gecondest,
                      gelqf, gels, gels_cholqr, gels_qr, geqrf, gerbt, gesv,
                      gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf,

@@ -186,6 +186,25 @@ def set(offdiag_value, diag_value, A, opts=None):  # noqa: A001 - reference name
     return write_back(A, out)
 
 
+def set_from_function(value, A, opts=None):
+    """Set entries A[i, j] = value(i, j) (src/set_lambdas.cc).
+
+    TPU re-design: the reference evaluates a per-entry host lambda inside
+    each tile task; here ``value`` receives broadcastable global index arrays
+    (I of shape (m, 1), J of shape (1, n)) and is evaluated once, vectorized
+    — jnp-traceable functions stay on device, numpy functions work too."""
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    I = jnp.arange(m)[:, None]
+    J = jnp.arange(n)[None, :]
+    vals = jnp.broadcast_to(jnp.asarray(value(I, J), dtype=a.dtype),
+                            a.shape[-2:])
+    return write_back(A, jnp.broadcast_to(vals, a.shape))
+
+
+set_lambdas = set_from_function   # reference driver name (src/set_lambdas.cc)
+
+
 def norm(norm_kind, A, opts=None, scope=NormScope.Matrix, uplo=None, diag=None):
     """Matrix norm dispatched on matrix type (src/norm.cc).
 

@@ -151,6 +151,21 @@ def test_add_copy_scale_set(rng):
     np.testing.assert_allclose(np.asarray(A.array), np.diag(r) @ a @ np.diag(c), rtol=1e-12)
 
 
+def test_set_from_function(rng):
+    """set_lambdas analogue (src/set_lambdas.cc): entries from a broadcastable
+    (i, j) function, transposition handled by the wrapper."""
+    a = _rand(rng, 6, 4)
+    A = slate.Matrix.from_array(a.copy(), nb=2)
+    slate.set_from_function(lambda i, j: 10.0 * i + j, A)
+    i, j = np.mgrid[0:6, 0:4]
+    np.testing.assert_allclose(np.asarray(A.array), 10.0 * i + j, rtol=1e-12)
+    # alias + transposed view: value(i, j) addresses the view's coordinates,
+    # so storage receives the transpose (B.array[r, c] = c - r)
+    B = slate.Matrix.from_array(a.copy(), nb=2)
+    slate.set_lambdas(lambda i, j: i - j, B.T)
+    np.testing.assert_allclose(np.asarray(B.array), j - i, rtol=1e-12)
+
+
 def test_copy_precision_convert(rng):
     a = _rand(rng, 6, 6)
     A = slate.Matrix.from_array(a, nb=2)
