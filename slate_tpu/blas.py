@@ -197,9 +197,15 @@ def set_from_function(value, A, opts=None):
     m, n = a.shape[-2:]
     I = jnp.arange(m)[:, None]
     J = jnp.arange(n)[None, :]
-    vals = jnp.broadcast_to(jnp.asarray(value(I, J), dtype=a.dtype),
-                            a.shape[-2:])
-    return write_back(A, jnp.broadcast_to(vals, a.shape))
+    vals = jnp.broadcast_to(jnp.asarray(value(I, J), dtype=a.dtype), a.shape)
+    if isinstance(A, BaseTrapezoidMatrix):
+        # only the stored triangle is set; the off-triangle of shared storage
+        # passes through untouched (same contract as set()/tzset)
+        from .core.types import Uplo
+
+        mask = (I >= J) if A.uplo == Uplo.Lower else (I <= J)
+        vals = jnp.where(mask, vals, a)
+    return write_back(A, vals)
 
 
 set_lambdas = set_from_function   # reference driver name (src/set_lambdas.cc)

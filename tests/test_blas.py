@@ -164,6 +164,16 @@ def test_set_from_function(rng):
     B = slate.Matrix.from_array(a.copy(), nb=2)
     slate.set_lambdas(lambda i, j: i - j, B.T)
     np.testing.assert_allclose(np.asarray(B.array), j - i, rtol=1e-12)
+    # triangular view: only the stored triangle is written (set()/tzset
+    # contract) — the off-triangle of shared storage passes through
+    sq = _rand(rng, 6, 6)
+    L = slate.TriangularMatrix.from_array("lower", sq.copy(), nb=2)
+    slate.set_from_function(lambda i, j: 100.0 + i + j, L)
+    got = np.asarray(L.array)
+    ii, jj = np.mgrid[0:6, 0:6]
+    np.testing.assert_allclose(np.tril(got), np.tril(100.0 + ii + jj),
+                               rtol=1e-12)
+    np.testing.assert_array_equal(np.triu(got, 1), np.triu(sq, 1))
 
 
 def test_copy_precision_convert(rng):
