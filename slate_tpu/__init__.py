@@ -30,7 +30,9 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      getrs_nopiv, hb2st, hbmm, he2hb, he2hb_q, heev, hegst,
                      hegv, hesv, hetrf, hetrs, norm1est, pbsv, pbtrf, pbtrs,
                      pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri,
-                     potrs, stedc, steqr, sterf, svd, svd_vals, sysv, sytrf,
+                     potrs, stedc, stedc_deflate, stedc_merge, stedc_secular,
+                     stedc_solve, stedc_sort, stedc_z_vector, steqr, steqr2,
+                     sterf, svd, svd_vals, syev, sygst, sygv, sysv, sytrf,
                      sytrs, tb2bd, tbsm, trcondest, trtri, trtrm, unmbr_ge2tb,
                      unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)
 from . import simplified
@@ -49,4 +51,24 @@ try:
 except ImportError:  # pragma: no cover - environment-specific
     parallel = None
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+VERSION = 2026_07_00   # yyyymmrr, the reference's integer form (version.cc)
+
+
+def version() -> int:
+    """Library version as the reference's yyyymmrr integer
+    (src/version.cc: slate::version())."""
+    return VERSION
+
+
+def id() -> str:  # noqa: A001 - reference name (slate::id)
+    """Git commit hash of this build, or "unknown" (src/version.cc: slate::id())."""
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=__path__[0]).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"

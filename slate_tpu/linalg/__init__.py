@@ -7,8 +7,13 @@ from .lu import (gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt
                  getrs_nopiv, perm_to_pivots, pivots_to_perm, rbt_generate)
 from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
                  geqrf, tsqr, unmlq, unmqr)
-from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr, sterf,
-                  unmtr_hb2st, unmtr_he2hb)
+# the submodule import must come first: importing .stedc binds the module
+# object onto the package as attribute "stedc", and the .eig import below
+# re-binds that name to the driver *function* (the public contract)
+from .stedc import (stedc_deflate, stedc_merge, stedc_secular, stedc_solve,
+                    stedc_sort, stedc_z_vector)
+from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr,
+                  steqr2, sterf, syev, sygst, sygv, unmtr_hb2st, unmtr_he2hb)
 from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest

@@ -655,3 +655,12 @@ def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):
     from .stedc import stedc as _stedc_impl
 
     return _stedc_impl(d, e, Z, opts)
+
+
+steqr2 = steqr   # the reference's steqr2 is a deprecated alias (slate.hh:1295)
+
+# real-symmetric spellings (the reference declares syev/sygv/sygst alongside
+# the he* forms, slate.hh; same drivers — Hermitian == symmetric over reals)
+syev = heev
+sygv = hegv
+sygst = hegst

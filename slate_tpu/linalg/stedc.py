@@ -102,6 +102,27 @@ def _secular_roots(d: jax.Array, z2: jax.Array, rho: jax.Array):
     return t, s, lam
 
 
+def _deflate(d_sorted, z_sorted, rho):
+    """Structural deflation on the sorted union (the stedc_deflate analogue;
+    see module docstring): minimal spacing for equal diagonals, z^2 floor for
+    tiny couplings.  Returns (d_spaced, z2_floored, scale, eps)."""
+    dt = d_sorted.dtype
+    m = d_sorted.shape[0]
+    scale = jnp.maximum(jnp.abs(d_sorted[0]), jnp.abs(d_sorted[-1])) + rho
+    eps = jnp.finfo(dt).eps
+    # minimal spacing (equal-diagonal deflation as perturbation)
+    gap_min = 8 * eps * scale
+    ar = jnp.arange(m, dtype=dt)
+    d = jnp.maximum.accumulate(d_sorted - gap_min * ar) + gap_min * ar
+    # z-floor deflation: LAPACK drops tiny-z entries from the secular problem;
+    # with static shapes we instead *floor* z^2 so every bracket keeps a pole
+    # on each side and a strictly interior root.  Strict interlacing is what
+    # Gu's product formula needs for globally orthogonal vectors; the floor
+    # perturbs T by ~m * eps^2 * scale, far below one ulp of the spectrum.
+    z2 = z_sorted * z_sorted + (eps * scale) ** 2 / jnp.maximum(rho, eps)
+    return d, z2, scale, eps
+
+
 def _merge(d1, Q1, d2, Q2, rho_raw):
     """One D&C merge (stedc_merge + stedc_z_vector + stedc_secular +
     stedc_solve): rank-one update D + rho z z^T in the blkdiag(Q1, Q2) basis."""
@@ -116,18 +137,7 @@ def _merge(d1, Q1, d2, Q2, rho_raw):
     order = jnp.argsort(d)
     d = d[order]
     z = z[order]
-    scale = jnp.maximum(jnp.abs(d[0]), jnp.abs(d[-1])) + rho
-    eps = jnp.finfo(dt).eps
-    # minimal spacing (equal-diagonal deflation as perturbation)
-    gap_min = 8 * eps * scale
-    ar = jnp.arange(m, dtype=dt)
-    d = jnp.maximum.accumulate(d - gap_min * ar) + gap_min * ar
-    # z-floor deflation: LAPACK drops tiny-z entries from the secular problem;
-    # with static shapes we instead *floor* z^2 so every bracket keeps a pole
-    # on each side and a strictly interior root.  Strict interlacing is what
-    # Gu's product formula needs for globally orthogonal vectors; the floor
-    # perturbs T by ~m * eps^2 * scale, far below one ulp of the spectrum.
-    z2 = z * z + (eps * scale) ** 2 / jnp.maximum(rho, eps)
+    d, z2, scale, eps = _deflate(d, z, rho)
 
     t, s, lam = _secular_roots(d, z2, rho)
 
@@ -242,3 +252,60 @@ def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):
         Q = jnp.matmul(Z.astype(Q.dtype) if Z.dtype != Q.dtype else Z, Q,
                        precision=lax.Precision.HIGHEST)
     return lam, Q
+
+
+# ---------------------------------------------------------------------------
+# Stage entry points (the reference exposes each D&C stage publicly,
+# slate.hh:1210-1264; these are the TPU-idiomatic functional forms)
+# ---------------------------------------------------------------------------
+
+
+def stedc_z_vector(Q1, Q2):
+    """Coupling vector of a merge: last row of Q1 over first row of Q2
+    (src/stedc_z_vector.cc — there, gathered over the distributed Q)."""
+    return jnp.concatenate([jnp.asarray(Q1)[-1, :], jnp.asarray(Q2)[0, :]])
+
+
+def stedc_sort(d, Q):
+    """Ascending eigenvalue sort with matching column permutation of Q
+    (src/stedc_sort.cc).  Returns (d_sorted, Q_sorted)."""
+    d = jnp.asarray(d)
+    order = jnp.argsort(d)
+    return d[order], jnp.asarray(Q)[:, order]
+
+
+def stedc_deflate(rho, d, z):
+    """Deflation stage on the sorted union (src/stedc_deflate.cc).
+
+    The reference rotates equal diagonals together and drops tiny couplings,
+    shrinking the secular problem; with static shapes the same effect is a
+    backward-error perturbation — minimal diagonal spacing plus a z^2 floor
+    (module docstring).  Returns (d_hat, z2_hat): the spaced diagonal and the
+    floored squared couplings that feed stedc_secular.
+    """
+    d = jnp.asarray(d)
+    rho = jnp.abs(jnp.asarray(rho))
+    d_hat, z2_hat, _, _ = _deflate(d, jnp.asarray(z), rho)
+    return d_hat, z2_hat
+
+
+def stedc_secular(rho, d, z2):
+    """Secular equation stage (src/stedc_secular.cc / laed4): all m roots of
+    1 + rho * sum_i z2_i / (d_i - lam) = 0 by closer-pole bisection.
+    Returns the ascending eigenvalues."""
+    _, _, lam = _secular_roots(jnp.asarray(d), jnp.asarray(z2),
+                               jnp.abs(jnp.asarray(rho)))
+    return lam
+
+
+def stedc_merge(d1, Q1, d2, Q2, rho):
+    """One full merge of two solved halves (src/stedc_merge.cc).
+    Returns (eigenvalues, blkdiag(Q1, Q2) @ U)."""
+    return _merge_jit(jnp.asarray(d1), jnp.asarray(Q1), jnp.asarray(d2),
+                      jnp.asarray(Q2), jnp.asarray(rho))
+
+
+def stedc_solve(d, e):
+    """The recursive D&C solve without a pre-multiplied Z
+    (src/stedc_solve.cc).  Returns (ascending eigenvalues, Q)."""
+    return stedc(d, e)

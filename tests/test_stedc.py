@@ -130,6 +130,48 @@ class TestStedc:
                                  rho * np.outer(np.sqrt(z2), np.sqrt(z2)))
         np.testing.assert_allclose(lam, ref, atol=2e-5)
 
+    def test_stage_entry_points(self):
+        """The public D&C stage functions (slate.hh:1210-1264 exposes each
+        stage; stedc_z_vector/sort/deflate/secular/merge/solve) compose to the
+        same answer as the full driver."""
+        from slate_tpu.linalg import (stedc_deflate, stedc_merge,
+                                      stedc_secular, stedc_solve, stedc_sort,
+                                      stedc_z_vector)
+
+        r = np.random.default_rng(3)
+        n = 48
+        d = r.standard_normal(n)
+        e = np.abs(r.standard_normal(n - 1)) + 0.1
+        lam_ref = np.linalg.eigvalsh(_tri(d, e))
+
+        # solve halves, merge via the public stage
+        mid = n // 2
+        rho = e[mid - 1]
+        d1 = np.concatenate([d[: mid - 1], [d[mid - 1] - rho]])
+        d2 = np.concatenate([[d[mid] - rho], d[mid + 1:]])
+        l1, Q1 = stedc_solve(jnp.asarray(d1), jnp.asarray(e[: mid - 1]))
+        l2, Q2 = stedc_solve(jnp.asarray(d2), jnp.asarray(e[mid:]))
+        lam, Q = stedc_merge(l1, Q1, l2, Q2, rho)
+        assert np.abs(np.sort(np.asarray(lam)) - lam_ref).max() < 1e-10
+        QQ = np.asarray(Q)
+        assert np.abs(QQ.T @ QQ - np.eye(n)).max() < 1e-10
+
+        # z-vector + deflate + secular reproduce the merge eigenvalues
+        z = np.asarray(stedc_z_vector(Q1, Q2))
+        du = np.concatenate([np.asarray(l1), np.asarray(l2)])
+        order = np.argsort(du)
+        dh, z2h = stedc_deflate(rho, jnp.asarray(du[order]),
+                                jnp.asarray(z[order]))
+        lam2 = np.asarray(stedc_secular(rho, dh, z2h))
+        assert np.abs(np.sort(lam2) - lam_ref).max() < 1e-10
+
+        # sort contract
+        ds, Qs = stedc_sort(lam, Q)
+        assert np.all(np.diff(np.asarray(ds)) >= 0)
+        T = _tri(d, e)
+        assert np.abs(T @ np.asarray(Qs)
+                      - np.asarray(Qs) * np.asarray(ds)[None, :]).max() < 1e-9
+
     def test_heev_dc_method(self):
         """heev(opts.method_eig=DC) routes the two-stage pipeline through stedc."""
         r = np.random.default_rng(7)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Parity audit: every public routine of the reference's slate.hh checked
+against the slate_tpu surface (top-level, linalg, blas, parallel, simplified).
+
+Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/parity_audit.py
+
+Exit status 0 iff every reference routine resolves.  Names the framework
+deliberately re-spells are listed in RENAMES (the audit follows them);
+anything else must exist under the reference's own name.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+sys.path.insert(0, os.path.dirname(_TOOLS))     # repo root for slate_tpu
+from force_cpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend(virtual_devices=1)
+
+REF_HEADER = "/root/reference/include/slate/slate.hh"
+
+# reference name -> where we provide it under a different spelling
+# (set_lambdas/set_from_function cover the reference's lambda-set overload)
+RENAMES = {
+    "gesvd": "svd",                 # the reference itself aliases gesvd -> svd
+    "colNorms": "col_norms",
+}
+NOT_ROUTINES = {"scalar_t"}         # artifacts of the header scrape
+
+
+def reference_routines():
+    names = set()
+    pat = re.compile(r"^[A-Za-z0-9_:<>,& ]*?\b([a-z][a-z0-9_]*)\s*\(")
+    with open(REF_HEADER) as f:
+        for line in f:
+            m = pat.match(line)
+            if m:
+                names.add(m.group(1))
+    return sorted(names - NOT_ROUTINES)
+
+
+def resolve(name: str):
+    import slate_tpu
+    from slate_tpu import blas, linalg, parallel, simplified
+
+    target = RENAMES.get(name, name)
+    for mod in (slate_tpu, linalg, blas, simplified, parallel):
+        if hasattr(mod, target):
+            return f"{mod.__name__}.{target}"
+        if hasattr(mod, target + "_distributed"):
+            return f"{mod.__name__}.{target}_distributed"
+    return None
+
+
+def main() -> int:
+    missing = []
+    rows = []
+    for name in reference_routines():
+        where = resolve(name)
+        rows.append((name, where or "MISSING"))
+        if where is None:
+            missing.append(name)
+    width = max(len(n) for n, _ in rows)
+    for name, where in rows:
+        print(f"{name:<{width}}  {where}")
+    print(f"\n{len(rows) - len(missing)}/{len(rows)} reference routines covered")
+    if missing:
+        print("MISSING:", ", ".join(missing))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
