@@ -63,12 +63,20 @@ def version() -> int:
 
 def id() -> str:  # noqa: A001 - reference name (slate::id)
     """Git commit hash of this build, or "unknown" (src/version.cc: slate::id())."""
+    import os
     import subprocess
 
     try:
+        pkg = os.path.abspath(__path__[0])
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], capture_output=True,
+            text=True, timeout=5, cwd=pkg).stdout.strip()
+        # an installed copy may sit under an unrelated enclosing repo — only
+        # report a hash when the repo actually contains this package
+        if not top or not pkg.startswith(os.path.abspath(top) + os.sep):
+            return "unknown"
         return subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
-            text=True, timeout=5,
-            cwd=__path__[0]).stdout.strip() or "unknown"
+            text=True, timeout=5, cwd=pkg).stdout.strip() or "unknown"
     except Exception:
         return "unknown"

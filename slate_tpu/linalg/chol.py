@@ -107,6 +107,31 @@ def _host_chol_info(a, nb: int = 256) -> int:
     return 0
 
 
+_CHOL_BASE = 256
+
+
+def _chol_blocked(a):
+    """Recursive blocked Cholesky of one diagonal block: factor the leading
+    half, one triangular solve, one Schur-complement MXU gemm, recurse.  XLA's
+    fused Cholesky serializes its internal panel recursion and crawls on large
+    blocks (BENCH_NOTES.md); the fused op runs only at the <=256 base."""
+    n = a.shape[-1]
+    if n <= _CHOL_BASE:
+        return lax.linalg.cholesky(a)
+    h = n // 2
+    a11, a21, a22 = a[..., :h, :h], a[..., h:, :h], a[..., h:, h:]
+    l11 = _chol_blocked(a11)
+    l21 = lax.linalg.triangular_solve(l11, a21, left_side=False, lower=True,
+                                      conjugate_a=True, transpose_a=True)
+    s = a22 - jnp.matmul(l21, jnp.conj(jnp.swapaxes(l21, -1, -2)),
+                         precision=lax.Precision.HIGHEST)
+    l22 = _chol_blocked(s)
+    zeros = jnp.zeros(a.shape[:-2] + (h, n - h), a.dtype)
+    return jnp.concatenate(
+        [jnp.concatenate([l11, zeros], axis=-1),
+         jnp.concatenate([l21, l22], axis=-1)], axis=-2)
+
+
 @lru_cache(maxsize=32)
 def _potrf_tiled_fn(n: int, nb: int, dtype_str: str):
     """Build + jit the blocked right-looking factorization for static (n, nb)."""
@@ -119,7 +144,7 @@ def _potrf_tiled_fn(n: int, nb: int, dtype_str: str):
             k0, k1 = k * nb, min((k + 1) * nb, n)
             # panel factor (≅ internal::potrf on the diagonal tile, potrf.cc:96-102)
             Akk = L[k0:k1, k0:k1]
-            Lkk = lax.linalg.cholesky(Akk)
+            Lkk = _chol_blocked(Akk)
             L = L.at[k0:k1, k0:k1].set(Lkk)
             if k1 < n:
                 # panel trsm (≅ internal::trsm over the panel, potrf.cc:115-119);

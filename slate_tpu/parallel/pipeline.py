@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import numpy as np
 
 from .mesh import ProcessGrid
+from ..linalg.chol import _chol_blocked
 
 _AXIS = "d"
 
@@ -58,7 +59,7 @@ def _potrf_pipelined_fn(mesh, n: int, nb: int, d: int, dtype_str: str):
         rows = jnp.arange(n)
         start = k * nb
         D = lax.dynamic_slice(col, (start, 0), (nb, nb))
-        Lkk = lax.linalg.cholesky(D)
+        Lkk = _chol_blocked(D)
         below = jnp.where((rows >= start + nb)[:, None], col, 0)
         panel = lax.linalg.triangular_solve(
             Lkk, below, left_side=False, lower=True,

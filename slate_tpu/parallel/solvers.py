@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.exceptions import slate_assert
 from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from ..linalg.chol import _chol_blocked
 
 
 # ---------------------------------------------------------------------------
@@ -47,7 +48,7 @@ def _potrf_dist_fn(mesh, n: int, nb: int, dtype_str: str):
             k0, k1 = k * nb, min((k + 1) * nb, n)
             # panel factor on the nb×nb diagonal block — small, so GSPMD replicates
             # it (the reference also runs internal::potrf on one tile, potrf.cc:96)
-            Lkk = lax.linalg.cholesky(L[k0:k1, k0:k1])
+            Lkk = _chol_blocked(L[k0:k1, k0:k1])
             L = L.at[k0:k1, k0:k1].set(Lkk)
             if k1 < n:
                 panel = lax.linalg.triangular_solve(
@@ -90,7 +91,7 @@ def _potrf_dist_loop_fn(mesh, n: int, nb: int, dtype_str: str):
         k0 = k * nb
         rows = jnp.arange(n)
         Dkk = lax.dynamic_slice(L, (k0, k0), (nb, nb))
-        Lkk = lax.linalg.cholesky(Dkk)
+        Lkk = _chol_blocked(Dkk)
         L = lax.dynamic_update_slice(L, Lkk, (k0, k0))
         # full-height panel solve; rows above the diagonal block are masked out
         P_ = lax.dynamic_slice(L, (0, k0), (n, nb))
