@@ -67,13 +67,13 @@ def id() -> str:  # noqa: A001 - reference name (slate::id)
     import subprocess
 
     try:
-        pkg = os.path.abspath(__path__[0])
-        top = subprocess.run(
-            ["git", "rev-parse", "--show-toplevel"], capture_output=True,
-            text=True, timeout=5, cwd=pkg).stdout.strip()
+        pkg = os.path.realpath(__path__[0])
         # an installed copy may sit under an unrelated enclosing repo — only
-        # report a hash when the repo actually contains this package
-        if not top or not pkg.startswith(os.path.abspath(top) + os.sep):
+        # report a hash when the repo actually *tracks* this package
+        tracked = subprocess.run(
+            ["git", "ls-files", "--error-unmatch", pkg], capture_output=True,
+            text=True, timeout=5, cwd=pkg)
+        if tracked.returncode != 0:
             return "unknown"
         return subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,

@@ -115,6 +115,17 @@ class TestDistributedSolvers:
         assert res < 1e-12
         assert len(L.sharding.device_set) == 8
 
+    def test_potrf_loop_method_large_panel_count(self, grid24, rng):
+        """The O(1)-program fori_loop body (auto-selected past 32 panels, the
+        BASELINE n=16384/nb=256 regime) must agree with the unrolled body."""
+        n = 144
+        A = _spd(rng, n)
+        L_ref = np.linalg.cholesky(np.asarray(A))
+        L_auto = np.asarray(potrf_distributed(A, grid24, nb=4))  # nt=36>cutoff
+        assert np.abs(L_auto - L_ref).max() < 1e-8
+        L_loop = np.asarray(potrf_distributed(A, grid24, nb=16, method="loop"))
+        assert np.abs(L_loop - L_ref).max() < 1e-8
+
     def test_posv_solves(self, grid24, rng):
         n, nrhs = 32, 8
         A = _spd(rng, n)
