@@ -210,6 +210,41 @@ def _trmm_fn(mesh, left: bool, lower: bool, trans: bool, unit_diag: bool):
     return jax.jit(fn, in_shardings=(spec, spec, None), out_shardings=spec)
 
 
+def gbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
+                     kl: int, ku: int) -> jax.Array:
+    """C = alpha A B + beta C with A a general band matrix (src/gbmm.cc over
+    the grid).  The band structure is a mask — zeros outside the band keep
+    every shard's matmul dense on the MXU (SURVEY.md §2.5 mapping) — and the
+    product rides the SUMMA all-gather gemm."""
+    from ..linalg.band import _band_mask
+    from .summa import gemm_allgather
+
+    m, k = A.shape[-2:]
+    n = B.shape[-1]
+    slate_assert(B.shape[-2] == k, f"gbmm inner dims {k} != {B.shape[-2]}")
+    slate_assert(C.shape[-2:] == (m, n), f"gbmm C must be {m}x{n}")
+    Am = A * _band_mask(m, k, kl, ku, A.dtype)
+    kmult = lcm(grid.p, grid.q)
+    Ap = pad2d(Am, grid.p, kmult)
+    Bp = pad2d(B, kmult, grid.q)
+    prod = gemm_allgather(Ap, Bp, grid)[:m, :n]
+    return alpha * prod + beta * C
+
+
+def hbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
+                     kd: int, uplo: str = "lower") -> jax.Array:
+    """C = alpha A B + beta C with A Hermitian band, one triangle stored
+    (src/hbmm.cc over the grid; left side, like the reference)."""
+    from ..linalg.band import _band_mask
+
+    n = A.shape[-1]
+    lower = uplo == "lower"
+    tri = A * _band_mask(n, n, kd if lower else 0, 0 if lower else kd, A.dtype)
+    # the hemm kernel reconstructs the full Hermitian operand from the stored
+    # (band-masked) triangle in-trace
+    return hemm_distributed("left", alpha, tri, B, beta, C, grid, uplo=uplo)
+
+
 def trmm_distributed(side, alpha, A, B, grid: ProcessGrid,
                      uplo: str = "lower", conj_trans: bool = False,
                      unit_diag: bool = False) -> jax.Array:

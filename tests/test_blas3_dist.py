@@ -122,6 +122,39 @@ class TestHemmSymmTrmm:
         np.testing.assert_allclose(out, np.conj(tri).T @ b, atol=1e-10)
 
 
+class TestBandDistributed:
+    def test_gbmm(self, grid24, rng):
+        from slate_tpu.parallel import gbmm_distributed
+
+        m, k, n, kl, ku = 20, 16, 12, 3, 2
+        a = rng.standard_normal((m, k))
+        b = rng.standard_normal((k, n))
+        c = rng.standard_normal((m, n))
+        band = np.where((np.arange(m)[:, None] - np.arange(k)[None, :] <= kl)
+                        & (np.arange(k)[None, :] - np.arange(m)[:, None] <= ku),
+                        a, 0.0)
+        out = np.asarray(gbmm_distributed(
+            2.0, jnp.asarray(a), jnp.asarray(b), 0.5, jnp.asarray(c), grid24,
+            kl=kl, ku=ku))
+        np.testing.assert_allclose(out, 2.0 * band @ b + 0.5 * c, atol=1e-10)
+
+    def test_hbmm(self, grid22, rng):
+        from slate_tpu.parallel import hbmm_distributed
+
+        n, kd = 16, 3
+        a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        b = rng.standard_normal((n, 5)) + 1j * rng.standard_normal((n, 5))
+        c = np.zeros((n, 5), complex)
+        ii, jj = np.mgrid[0:n, 0:n]
+        tri = np.where((ii - jj >= 0) & (ii - jj <= kd), a, 0.0)
+        full = (np.diag(np.real(np.diagonal(tri))) + np.tril(tri, -1)
+                + np.conj(np.tril(tri, -1)).T)
+        out = np.asarray(hbmm_distributed(
+            1.0, jnp.asarray(a), jnp.asarray(b), 0.0, jnp.asarray(c), grid22,
+            kd=kd, uplo="lower"))
+        np.testing.assert_allclose(out, full @ b, atol=1e-10)
+
+
 class TestScalapackSkin:
     def test_pdsyrk_distributes(self, rng):
         from slate_tpu import scalapack_api as sk

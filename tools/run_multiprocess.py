@@ -118,6 +118,36 @@ def worker(coord: str, pid: int) -> None:
             blk, Xref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-3)
     print(f"worker {pid}: posv OK", flush=True)
 
+    # --- 4) tournament-pivoted LU spanning the process boundary -------------
+    from slate_tpu.parallel import gesv_distributed
+
+    G = rng.standard_normal((m, m)).astype(np.float32) + m * np.eye(
+        m, dtype=np.float32)
+    Xg, info = gesv_distributed(jnp.asarray(G), jnp.asarray(Bh), grid, nb=8)
+    assert int(np.asarray(info.addressable_shards[0].data)) == 0
+    Xgref = np.linalg.solve(G, Bh)
+    for shard in Xg.addressable_shards:
+        r0, c0 = (sl.start or 0 for sl in shard.index)
+        blk = np.asarray(shard.data)
+        np.testing.assert_allclose(
+            blk, Xgref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-3)
+    print(f"worker {pid}: gesv OK", flush=True)
+
+    # --- 5) explicit shard_map rank-k update (herk panel broadcast) ---------
+    from slate_tpu.parallel import herk_distributed
+
+    Ah = rng.standard_normal((m, 8)).astype(np.float32)
+    Ch = rng.standard_normal((m, m)).astype(np.float32)
+    Hk = herk_distributed(1.0, jnp.asarray(Ah), 0.5, jnp.asarray(Ch), grid)
+    href = np.where(np.tril(np.ones((m, m), bool)),
+                    Ah @ Ah.T + 0.5 * Ch, Ch)
+    for shard in Hk.addressable_shards:
+        r0, c0 = (sl.start or 0 for sl in shard.index)
+        blk = np.asarray(shard.data)
+        np.testing.assert_allclose(
+            blk, href[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-3)
+    print(f"worker {pid}: herk OK", flush=True)
+
     jax.distributed.shutdown()
     print(f"worker {pid}: OK", flush=True)
 
