@@ -120,6 +120,30 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     return lam * factor, Z
 
 
+def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
+                     grid: ProcessGrid, nb: int = 64,
+                     want_vectors: bool = True):
+    """Distributed generalized Hermitian eigensolve A x = lambda B x
+    (src/hegv.cc over the mesh): sharded potrf(B) -> hegst transform (sharded
+    triangular solves / gemms) -> heev_distributed -> sharded back-transform.
+
+    Returns (ascending eigenvalues, X or None).
+    """
+    from ..linalg.eig import hegst
+    from .solvers import potrf_distributed, trsm_distributed
+
+    L = potrf_distributed(B, grid, nb=max(nb, 32))
+    C = hegst(itype, _shard(A, grid), L)
+    lam, Z = heev_distributed(C, grid, nb=nb, want_vectors=want_vectors)
+    if not want_vectors:
+        return lam, None
+    if itype in (1, 2):
+        X = trsm_distributed(L, Z, grid, lower=True, conj_trans=True)
+    else:
+        X = jnp.matmul(jnp.tril(L), Z, precision=lax.Precision.HIGHEST)
+    return lam, X
+
+
 def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                     want_vectors: bool = True, chase_pipeline: bool = False):
     """Distributed SVD over the (p, q) mesh (src/svd.cc pipeline).

@@ -338,3 +338,48 @@ def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
         return (getrs_distributed(LU, perm, B, grid), perm, info, iters,
                 False)
     return X, perm, info, iters, True
+
+
+def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
+                                 grid: ProcessGrid, nb: int = 256, opts=None):
+    """Distributed GMRES-IR (src/gesv_mixed_gmres.cc over the mesh): FGMRES in
+    working precision with sharded matvecs, right-preconditioned by the
+    low-precision tournament-LU solve (factor sharded, solves in-trace).
+    Single-RHS like the reference.  Returns (X, perm, info, restarts,
+    converged); falls back to the full-precision sharded solve on stall.
+    """
+    from ..core.types import Options
+    from ..linalg.lu import _gmres_ir, lu_factored_solve
+    from .solvers import _lower_dtype
+
+    opts = Options.make(opts)
+    vec = B.ndim == 1
+    B2 = B[:, None] if vec else B       # the sharded solves need 2-D RHS
+
+    def fallback():
+        LUf, permf, infof = getrf_distributed(A, grid, nb=nb)
+        Xf = getrs_distributed(LUf, permf, B2, grid)
+        return (Xf[:, 0] if vec else Xf), permf, infof
+
+    lo = opts.factor_precision or _lower_dtype(A.dtype)
+    if lo is None:
+        Xf, permf, infof = fallback()
+        return Xf, permf, infof, 0, True
+    LU, perm, info = getrf_distributed(A.astype(lo), grid, nb=nb)
+    spec = grid.spec()
+    LUs = jax.device_put(LU, spec)
+    As = jax.device_put(A, spec)
+
+    def matvec(x):
+        return jnp.matmul(As, x, precision=lax.Precision.HIGHEST)
+
+    def precond(r):
+        z = lu_factored_solve(LUs, perm, r.astype(lo)[:, None])
+        return z[:, 0].astype(B.dtype)
+
+    X, restarts, converged = _gmres_ir(matvec, precond, B, opts,
+                                       "gesv_mixed_gmres_distributed")
+    if not converged:
+        Xf, permf, infof = fallback()
+        return Xf, permf, infof, int(restarts), False
+    return X, perm, info, int(restarts), True

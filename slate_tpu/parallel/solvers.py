@@ -260,6 +260,47 @@ def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
     return X, iters, True
 
 
+def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
+                                 grid: ProcessGrid, nb: int = 256, opts=None):
+    """Distributed SPD GMRES-IR (src/posv_mixed_gmres.cc over the mesh):
+    FGMRES with sharded matvecs, right-preconditioned by the low-precision
+    sharded Cholesky solve.  Single-RHS like the reference.  Returns
+    (X, restarts, converged); full-precision sharded fallback on stall."""
+    from ..core.types import Options
+    from ..linalg.lu import _gmres_ir
+
+    opts = Options.make(opts)
+    vec = B.ndim == 1
+    B2 = B[:, None] if vec else B       # the sharded solves need 2-D RHS
+
+    def fallback():
+        Xf = posv_distributed(Af, B2, grid, nb=nb)
+        return Xf[:, 0] if vec else Xf
+
+    lo = opts.factor_precision or _lower_dtype(Af.dtype)
+    if lo is None:
+        return fallback(), 0, True
+    L = jax.device_put(potrf_distributed(Af.astype(lo), grid, nb=nb),
+                       grid.spec())
+    As = jax.device_put(Af, grid.spec())
+
+    def matvec(x):
+        return jnp.matmul(As, x, precision=lax.Precision.HIGHEST)
+
+    def precond(r):
+        y = lax.linalg.triangular_solve(L, r.astype(lo)[:, None],
+                                        left_side=True, lower=True)
+        z = lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                        conjugate_a=True, transpose_a=True)
+        return z[:, 0].astype(B.dtype)
+
+    X, restarts, converged = _gmres_ir(matvec, precond, B, opts,
+                                       "posv_mixed_gmres_distributed")
+    if not converged:
+        return fallback(), int(restarts), False
+    return X, int(restarts), True
+
+
 # ---------------------------------------------------------------------------
 # Tall-skinny CholQR (communication-avoiding QR)
 # ---------------------------------------------------------------------------
