@@ -129,10 +129,13 @@ def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
 
     Returns (ascending eigenvalues, X or None).
     """
+    from ..core.exceptions import SlateError
     from ..linalg.eig import hegst
     from .solvers import potrf_distributed, trsm_distributed
 
     L = potrf_distributed(B, grid, nb=max(nb, 32))
+    if not bool(jnp.all(jnp.isfinite(jnp.diagonal(L)))):
+        raise SlateError("hegv_distributed: B not positive definite")
     C = hegst(itype, _shard(A, grid), L)
     lam, Z = heev_distributed(C, grid, nb=nb, want_vectors=want_vectors)
     if not want_vectors:
@@ -170,6 +173,31 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         if not want_vectors:
             return S, None, None
         return S, jnp.conj(UT).T, jnp.conj(V).T
+    if m >= 2 * n:
+        # tall pre-step (svd.cc:224+): QR first — the reference QRs very tall
+        # inputs so the bidiagonalization runs on the square R.  With vectors,
+        # the 2-D CAQR tree over the mesh supplies Q, R and U = Q @ U_R is one
+        # sharded gemm; values-only skips Q entirely (singular values of R ==
+        # singular values of A for any QR), taking R from the sharded
+        # CholeskyQR2 Gram tree.
+        if not want_vectors:
+            # Householder-quality R from the 1-D TSQR tree (no Gram squaring,
+            # no 2-D CAQR Q accumulation)
+            from .qr_dist import tsqr_distributed
+
+            _, R = tsqr_distributed(A, grid)
+            S, _, _ = svd_distributed(R[:n, :n], grid, nb=nb,
+                                      want_vectors=False,
+                                      chase_pipeline=chase_pipeline)
+            return S, None, None
+        from .qr_dist import geqrf_distributed
+
+        Q, R = geqrf_distributed(A, grid, nb=max(nb, 32))
+        S, UR, VT = svd_distributed(R[:n, :n], grid, nb=nb,
+                                    want_vectors=True,
+                                    chase_pipeline=chase_pipeline)
+        U = jnp.matmul(Q[:, :n], UR, precision=lax.Precision.HIGHEST)
+        return S, _shard(U, grid), VT
     k = n
     nb = max(2, min(nb, max(2, k - 1)))
     a, factor = _safe_scale(A)

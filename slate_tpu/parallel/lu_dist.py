@@ -349,10 +349,12 @@ def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
     converged); falls back to the full-precision sharded solve on stall.
     """
     from ..core.types import Options
-    from ..linalg.lu import _gmres_ir, lu_factored_solve
+    from ..linalg.lu import _gmres_ir, _require_single_rhs, lu_factored_solve
+    from .eig_dist import _shard
     from .solvers import _lower_dtype
 
     opts = Options.make(opts)
+    _require_single_rhs(B, "gesv_mixed_gmres_distributed")
     vec = B.ndim == 1
     B2 = B[:, None] if vec else B       # the sharded solves need 2-D RHS
 
@@ -366,9 +368,9 @@ def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
         Xf, permf, infof = fallback()
         return Xf, permf, infof, 0, True
     LU, perm, info = getrf_distributed(A.astype(lo), grid, nb=nb)
-    spec = grid.spec()
-    LUs = jax.device_put(LU, spec)
-    As = jax.device_put(A, spec)
+    # sharding *constraints*, not device_put: GSPMD pads grid-indivisible n
+    LUs = _shard(LU, grid)
+    As = _shard(A, grid)
 
     def matvec(x):
         return jnp.matmul(As, x, precision=lax.Precision.HIGHEST)
@@ -380,6 +382,8 @@ def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
     X, restarts, converged = _gmres_ir(matvec, precond, B, opts,
                                        "gesv_mixed_gmres_distributed")
     if not converged:
+        if not opts.use_fallback_solver:
+            return X, perm, info, int(restarts), False
         Xf, permf, infof = fallback()
         return Xf, permf, infof, int(restarts), False
     return X, perm, info, int(restarts), True

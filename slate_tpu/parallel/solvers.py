@@ -267,9 +267,11 @@ def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
     sharded Cholesky solve.  Single-RHS like the reference.  Returns
     (X, restarts, converged); full-precision sharded fallback on stall."""
     from ..core.types import Options
-    from ..linalg.lu import _gmres_ir
+    from ..linalg.lu import _gmres_ir, _require_single_rhs
+    from .eig_dist import _shard
 
     opts = Options.make(opts)
+    _require_single_rhs(B, "posv_mixed_gmres_distributed")
     vec = B.ndim == 1
     B2 = B[:, None] if vec else B       # the sharded solves need 2-D RHS
 
@@ -280,9 +282,9 @@ def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
     lo = opts.factor_precision or _lower_dtype(Af.dtype)
     if lo is None:
         return fallback(), 0, True
-    L = jax.device_put(potrf_distributed(Af.astype(lo), grid, nb=nb),
-                       grid.spec())
-    As = jax.device_put(Af, grid.spec())
+    # sharding *constraints*, not device_put: GSPMD pads grid-indivisible n
+    L = _shard(potrf_distributed(Af.astype(lo), grid, nb=nb), grid)
+    As = _shard(Af, grid)
 
     def matvec(x):
         return jnp.matmul(As, x, precision=lax.Precision.HIGHEST)
@@ -297,6 +299,8 @@ def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
     X, restarts, converged = _gmres_ir(matvec, precond, B, opts,
                                        "posv_mixed_gmres_distributed")
     if not converged:
+        if not opts.use_fallback_solver:
+            return X, int(restarts), False
         return fallback(), int(restarts), False
     return X, int(restarts), True
 

@@ -77,7 +77,7 @@ class TestHeevDistributed:
 
 
 class TestSvdDistributed:
-    @pytest.mark.parametrize("m,n", [(40, 24), (24, 40), (32, 32)])
+    @pytest.mark.parametrize("m,n", [(40, 24), (24, 40), (32, 32), (96, 24)])
     def test_reconstruction(self, grid, m, n):
         a = rng(m + n).standard_normal((m, n)).astype(np.float32)
         S, U, VT = svd_distributed(jnp.asarray(a), grid, nb=6)

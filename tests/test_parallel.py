@@ -356,6 +356,46 @@ class TestTallDistributedLU:
             U = np.triu(LU[:n, :n])
             assert np.abs(a[perm] - L @ U).max() < 1e-4
 
+    def test_wide_factorization(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from slate_tpu.parallel import ProcessGrid, getrf_distributed
+
+        r = np.random.default_rng(2)
+        grid = ProcessGrid(2, 4)
+        for m, n in [(64, 96), (30, 100)]:
+            a = r.standard_normal((m, n)).astype(np.float32)
+            LU, perm, info = getrf_distributed(jnp.asarray(a), grid, nb=16)
+            LU, perm = np.asarray(LU), np.asarray(perm)
+            assert int(info) == 0
+            assert sorted(perm.tolist()) == list(range(m))
+            L = np.tril(LU[:, :m], -1) + np.eye(m, dtype=np.float32)
+            U = np.triu(LU)
+            assert np.abs(a[perm] - L @ U).max() < 1e-4
+
+    def test_tall_wrapper_routes(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import slate_tpu as slate
+        from slate_tpu.parallel import ProcessGrid
+
+        r = np.random.default_rng(1)
+        grid = ProcessGrid(2, 4)
+        m, n = 80, 48
+        a = r.standard_normal((m, n)).astype(np.float32)
+        Aw = slate.Matrix.from_array(jnp.asarray(a.copy()), nb=16, grid=grid)
+        LU, perm, info = slate.getrf(Aw, opts={"block_size": 16})
+        assert int(info) == 0
+        LU, perm = np.asarray(LU), np.asarray(perm)
+        L = np.tril(LU, -1)[:, :n] + np.eye(m, n, dtype=np.float32)
+        U = np.triu(LU[:n, :n])
+        assert np.abs(a[perm] - L @ U).max() < 1e-4
+
+
+class TestDistributedMixedAndGeneralized:
+    """Mixed-precision IR / GMRES-IR and generalized eigensolve over the
+    mesh (gesv_mixed.cc, posv_mixed_gmres.cc, hegv.cc analogues)."""
+
     def test_mixed_precision_distributed(self):
         """f32-factor + f64-refine over the mesh (gesv_mixed.cc / posv_mixed.cc
         analogue): IR must reach working-precision accuracy from the low
@@ -429,38 +469,3 @@ class TestTallDistributedLU:
         assert np.abs(np.sort(lam) - lam_ref).max() < 1e-7
         res = np.abs(a @ X - bmat @ X * lam[None, :]).max()
         assert res < 1e-6
-
-    def test_wide_factorization(self):
-        import numpy as np
-        import jax.numpy as jnp
-        from slate_tpu.parallel import ProcessGrid, getrf_distributed
-
-        r = np.random.default_rng(2)
-        grid = ProcessGrid(2, 4)
-        for m, n in [(64, 96), (30, 100)]:
-            a = r.standard_normal((m, n)).astype(np.float32)
-            LU, perm, info = getrf_distributed(jnp.asarray(a), grid, nb=16)
-            LU, perm = np.asarray(LU), np.asarray(perm)
-            assert int(info) == 0
-            assert sorted(perm.tolist()) == list(range(m))
-            L = np.tril(LU[:, :m], -1) + np.eye(m, dtype=np.float32)
-            U = np.triu(LU)
-            assert np.abs(a[perm] - L @ U).max() < 1e-4
-
-    def test_tall_wrapper_routes(self):
-        import numpy as np
-        import jax.numpy as jnp
-        import slate_tpu as slate
-        from slate_tpu.parallel import ProcessGrid
-
-        r = np.random.default_rng(1)
-        grid = ProcessGrid(2, 4)
-        m, n = 80, 48
-        a = r.standard_normal((m, n)).astype(np.float32)
-        Aw = slate.Matrix.from_array(jnp.asarray(a.copy()), nb=16, grid=grid)
-        LU, perm, info = slate.getrf(Aw, opts={"block_size": 16})
-        assert int(info) == 0
-        LU, perm = np.asarray(LU), np.asarray(perm)
-        L = np.tril(LU, -1)[:, :n] + np.eye(m, n, dtype=np.float32)
-        U = np.triu(LU[:n, :n])
-        assert np.abs(a[perm] - L @ U).max() < 1e-4
