@@ -64,6 +64,7 @@ def run_sweep(names: Sequence[str],
               seed: int = 0,
               repeat: int = 1,
               nrhs: int = 8,
+              grid=None,
               ref: bool = False,
               progress: Optional[Callable[[TestResult], None]] = None
               ) -> List[TestResult]:
@@ -78,7 +79,7 @@ def run_sweep(names: Sequence[str],
                     params = {"m": m, "n": n, "k": k, "nb": nb,
                               "dtype": DTYPES[tletter], "kind": kind,
                               "cond": cond, "seed": seed, "repeat": repeat,
-                              "nrhs": nrhs}
+                              "nrhs": nrhs, "grid": grid}
                     r = run_routine(routine, params)
                     if ref and r.ok:
                         r.ref_time_s = _ref_time(routine, params)

@@ -15,6 +15,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Optional
 
+from functools import lru_cache
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -35,6 +37,22 @@ def _routine(name: str, category: str):
 
 # ---------------------------------------------------------------------------
 # helpers
+
+def _grid(p):
+    """ProcessGrid for a grid-swept row (tester p x q dimension, like the
+    reference tester's --p/--q sweep) or None for single-device rows."""
+    g = p.get("grid")
+    if not g:
+        return None
+    return _grid_cached(tuple(g))
+
+
+@lru_cache(maxsize=8)
+def _grid_cached(pq):
+    from slate_tpu.parallel import ProcessGrid
+
+    return ProcessGrid(*pq)
+
 
 def _eps(dtype) -> float:
     return float(np.finfo(np.dtype(dtype).char.lower()
@@ -96,10 +114,12 @@ def run_gemm(p, slate):
     C0 = np.asarray(matgen.generate_matrix(p["kind"], m, n, dtype=p["dtype"],
                                            seed=p["seed"] + 2)[0])
     alpha, beta = 2.5, 0.5
-    Cm = slate.Matrix.from_array(C0.copy(), nb=p["nb"])
+    g = _grid(p)
+    Cm = slate.Matrix.from_array(C0.copy(), nb=p["nb"], grid=g)
     _, t = time_call(lambda: slate.gemm(
-        alpha, slate.Matrix.from_array(A, nb=p["nb"]),
-        slate.Matrix.from_array(B, nb=p["nb"]), beta, Cm), repeat=p["repeat"])
+        alpha, slate.Matrix.from_array(A, nb=p["nb"], grid=g),
+        slate.Matrix.from_array(B, nb=p["nb"], grid=g), beta, Cm),
+        repeat=p["repeat"])
     C = np.asarray(Cm.array)
     w = np.random.default_rng(0).standard_normal((n,)).astype(
         np.dtype(p["dtype"]).char.lower() if np.dtype(p["dtype"]).kind == "c"
@@ -218,7 +238,8 @@ def run_potrf(p, slate):
     n = p["n"]
     A = _spd(n, p)
     (L, info), t = time_call(lambda: slate.potrf(
-        slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(), nb=p["nb"])),
+        slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(),
+                                         nb=p["nb"], grid=_grid(p))),
         repeat=p["repeat"])
     Lf = np.tril(np.asarray(L.array if hasattr(L, "array") else L))
     err = _rel(np.linalg.norm(A - Lf @ Lf.conj().T), np.linalg.norm(A))
@@ -232,7 +253,8 @@ def run_posv(p, slate):
     b = _gen("randn", n, nrhs, p, )
     Bm = slate.Matrix.from_array(b.copy(), nb=p["nb"])
     _, t = time_call(lambda: slate.posv(
-        slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(), nb=p["nb"]),
+        slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(),
+                                         nb=p["nb"], grid=_grid(p)),
         Bm), repeat=p["repeat"])
     x = np.asarray(Bm.array)
     err = _rel(np.linalg.norm(A @ x - b),
@@ -279,8 +301,12 @@ def run_gesv(p, slate):
     n, nrhs = p["n"], p.get("nrhs", 10)
     A = _gen(p["kind"], n, n, p) + n * np.eye(n, dtype=p["dtype"])
     b = _gen("randn", n, nrhs, p)
-    (X, perm, info), t = time_call(lambda: slate.gesv(A.copy(), b.copy()),
-                                   repeat=p["repeat"])
+    g = _grid(p)
+    # wrapper built per call: gesv's getrf writes the LU factor back into a
+    # Matrix argument, so a hoisted wrapper would poison repeat > 1 timings
+    (X, perm, info), t = time_call(lambda: slate.gesv(
+        slate.Matrix.from_array(A.copy(), nb=p["nb"], grid=g)
+        if g is not None else A.copy(), b.copy()), repeat=p["repeat"])
     x = np.asarray(X)
     err = _rel(np.linalg.norm(A @ x - b), np.linalg.norm(A) * np.linalg.norm(x))
     return _result(p, err, 2 * n ** 3 / 3 + 2.0 * n * n * nrhs, t)
@@ -401,7 +427,11 @@ def run_heev(p, slate):
     """‖A Z − Z Λ‖/‖A‖ + ‖I − ZᴴZ‖ (the reference's eig check)."""
     n = p["n"]
     A = _herm(n, p)
-    (lam, Z), t = time_call(lambda: slate.heev(A.copy()), repeat=p["repeat"])
+    g = _grid(p)
+    Aop = (slate.HermitianMatrix.from_array(slate.Uplo.Lower, A.copy(),
+                                            nb=p["nb"], grid=g)
+           if g is not None else A.copy())
+    (lam, Z), t = time_call(lambda: slate.heev(Aop), repeat=p["repeat"])
     lam, Z = np.asarray(lam), np.asarray(Z)
     err1 = _rel(np.linalg.norm(A @ Z - Z * lam[None, :]), np.linalg.norm(A))
     err2 = np.linalg.norm(Z.conj().T @ Z - np.eye(n)) / n
@@ -425,7 +455,10 @@ def run_hegv(p, slate):
 def run_svd(p, slate):
     m, n = p["m"], p["n"]
     A = _gen(p["kind"], m, n, p)
-    (S, U, VT), t = time_call(lambda: slate.svd(A.copy()), repeat=p["repeat"])
+    g = _grid(p)
+    Aop = (slate.Matrix.from_array(A.copy(), nb=p["nb"], grid=g)
+           if g is not None else A.copy())
+    (S, U, VT), t = time_call(lambda: slate.svd(Aop), repeat=p["repeat"])
     S, U, VT = np.asarray(S), np.asarray(U), np.asarray(VT)
     k = min(m, n)
     err1 = _rel(np.linalg.norm(A - (U[:, :k] * S[None, :k]) @ VT[:k]),

@@ -55,6 +55,16 @@ class TestDispatch:
         assert r.status == "pass", (r.status, r.message)
         assert r.error is not None and r.time_s is not None
 
+    @pytest.mark.parametrize("routine", ["gemm", "potrf", "gesv"])
+    def test_grid_sweep_routes_distributed(self, routine):
+        """--grid PxQ rows run the distributed drivers (the reference
+        tester's p/q sweep dimension)."""
+        params = {"m": 32, "n": 32, "k": 32, "nb": 8, "dtype": np.float64,
+                  "kind": "randn", "cond": None, "seed": 0, "repeat": 1,
+                  "nrhs": 2, "grid": (2, 4)}
+        r = run_routine(routine, params)
+        assert r.status == "pass", (r.status, r.message)
+
     def test_runner_never_raises(self):
         # bad params produce an 'error' row, not an exception (tester contract)
         r = run_routine("gemm", {"m": 8})

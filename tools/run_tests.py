@@ -32,7 +32,17 @@ if _plat == "cpu":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from force_cpu import force_cpu_backend
 
-    force_cpu_backend()
+    # grid sweeps need enough virtual devices for the requested p x q
+    _vd = None
+    _argv = sys.argv[1:]
+    for _i, _a in enumerate(_argv):
+        _spec = (_a.split("=", 1)[1] if _a.startswith("--grid=")
+                 else _argv[_i + 1] if _a == "--grid" and _i + 1 < len(_argv)
+                 else None)
+        if _spec:
+            _p, _q = (int(x) for x in _spec.lower().split("x"))
+            _vd = _p * _q
+    force_cpu_backend(virtual_devices=_vd)
 else:
     os.environ["JAX_PLATFORMS"] = _plat
 
@@ -62,6 +72,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ref", action="store_true", help="time numpy reference too")
     ap.add_argument("--xml", default=None, help="write JUnit XML here")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grid", default=None, metavar="PxQ",
+                    help="sweep the distributed drivers on a PxQ process grid "
+                         "(virtual devices; the reference tester's p/q dims)")
     args = ap.parse_args(argv)
 
     cls = next((c for c in SIZE_CLASSES if getattr(args, c)), "quick")
@@ -93,9 +106,11 @@ def main(argv=None) -> int:
               f"t={tm}s gf={gf} err={err:.2e} {status} {r.message}", flush=True)
 
     t0 = time.time()
+    grid = (tuple(int(x) for x in args.grid.lower().split("x"))
+            if args.grid else None)
     results = run_sweep(names, dims, parse_list(args.type), cfg["nb"],
                         seed=args.seed, nrhs=cfg["nrhs"], ref=args.ref,
-                        progress=progress)
+                        grid=grid, progress=progress)
     elapsed = time.time() - t0
 
     npass = sum(1 for r in results if r.status == "pass")
