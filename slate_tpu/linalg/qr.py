@@ -335,6 +335,15 @@ def gels(A, BX, opts=None):
 
     Overdetermined (m >= n): X = R^{-1} Q^H B.  Underdetermined: minimum-norm via LQ.
     Returns the n x nrhs solution.
+
+    Rank-deficiency note (differs from the reference): when the CholQR/CSNE
+    route detects trouble (Gram Cholesky fails or the solve goes non-finite)
+    it falls back to Householder QR *and clamps vanishing R diagonals* at
+    sqrt(eps)·max|diag(R)|, i.e. numerically rank-deficient systems are
+    regularized (null directions get negligible weight) rather than erroring.
+    The reference's gels_qr/gels_cholqr make no such substitution.  Callers
+    who must detect rank deficiency should check ``jnp.abs(jnp.diagonal(R))``
+    from ``geqrf`` directly.
     """
     opts = Options.make(opts)
     a = as_array(A)
@@ -374,5 +383,8 @@ def gels_qr(A, BX, opts=None):
 
 
 def gels_cholqr(A, BX, opts=None):
-    """Least squares via CholeskyQR explicitly (src/gels_cholqr.cc)."""
+    """Least squares via CholeskyQR explicitly (src/gels_cholqr.cc).
+
+    See :func:`gels` for the rank-deficient fallback-and-clamp behavior of
+    this path (the QR fallback regularizes vanishing R diagonals)."""
     return gels(A, BX, Options.make(opts).replace(method_gels=MethodGels.CholQR))

@@ -64,6 +64,17 @@ def _col_block(a_row, n, q):
     return lax.dynamic_slice_in_dim(a_all, j * (n // q), n // q, axis=0)
 
 
+@lru_cache(maxsize=8)
+def _axpby_fn(mesh):
+    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def fn(alpha, x, beta, y):
+        return lax.with_sharding_constraint(alpha * x + beta * y, spec)
+
+    return jax.jit(fn, in_shardings=(None, spec, None, spec),
+                   out_shardings=spec)
+
+
 @lru_cache(maxsize=64)
 def _rank_k_fn(mesh, n: int, lower: bool, herm: bool, two: bool):
     p = mesh.shape[ROW_AXIS]
@@ -84,6 +95,15 @@ def _rank_k_fn(mesh, n: int, lower: bool, herm: bool, two: bool):
                 b_row, ct(a_col), precision=_PREC)
         else:
             upd = alpha * upd
+        if herm and jnp.issubdtype(c.dtype, jnp.complexfloating):
+            # her*k semantics: the Hermitian diagonal is real — drop any
+            # imaginary part of C's diagonal before beta scales it (the
+            # reference's herk does the same on the diagonal tiles)
+            i = lax.axis_index(ROW_AXIS)
+            j = lax.axis_index(COL_AXIS)
+            rows = i * (n // p) + jnp.arange(n // p)[:, None]
+            cols = j * (n // q) + jnp.arange(n // q)[None, :]
+            c = jnp.where(rows == cols, c.real.astype(c.dtype), c)
         mask = _tri_mask(n // p, n // q, lower)
         return jnp.where(mask, upd + beta * c, c)
 
@@ -227,14 +247,25 @@ def gbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
     kmult = lcm(grid.p, grid.q)
     Ap = pad2d(Am, grid.p, kmult)
     Bp = pad2d(B, kmult, grid.q)
-    prod = gemm_allgather(Ap, Bp, grid)[:m, :n]
-    return alpha * prod + beta * C
+    prod = gemm_allgather(Ap, Bp, grid)          # sharded, padded (mp, np)
+    # fold the axpy into a sharded program so the result keeps the grid
+    # sharding like every other *_distributed entry point (C is padded to the
+    # product's shape and placed on the grid first)
+    Cp = jax.device_put(
+        jnp.pad(C, ((0, prod.shape[-2] - m), (0, prod.shape[-1] - n))),
+        grid.spec())
+    dt = Cp.dtype
+    out = _axpby_fn(grid.mesh)(jnp.asarray(alpha, dt), prod,
+                               jnp.asarray(beta, dt), Cp)
+    return out[:m, :n] if out.shape[-2:] != (m, n) else out
 
 
 def hbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
-                     kd: int, uplo: str = "lower") -> jax.Array:
-    """C = alpha A B + beta C with A Hermitian band, one triangle stored
-    (src/hbmm.cc over the grid; left side, like the reference)."""
+                     kd: int, uplo: str = "lower",
+                     side: str = "left") -> jax.Array:
+    """C = alpha A B + beta C (side=left) or alpha B A + beta C (side=right)
+    with A Hermitian band, one triangle stored (src/hbmm.cc over the grid;
+    the reference's Side parameter, slate.hh:215)."""
     from ..linalg.band import _band_mask
 
     n = A.shape[-1]
@@ -242,7 +273,7 @@ def hbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
     tri = A * _band_mask(n, n, kd if lower else 0, 0 if lower else kd, A.dtype)
     # the hemm kernel reconstructs the full Hermitian operand from the stored
     # (band-masked) triangle in-trace
-    return hemm_distributed("left", alpha, tri, B, beta, C, grid, uplo=uplo)
+    return hemm_distributed(side, alpha, tri, B, beta, C, grid, uplo=uplo)
 
 
 def trmm_distributed(side, alpha, A, B, grid: ProcessGrid,

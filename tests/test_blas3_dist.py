@@ -46,7 +46,11 @@ class TestRankK:
         c = np.tril(c0) + np.conj(np.tril(c0, -1)).T   # hermitian-consistent
         out = np.asarray(herk_distributed(
             1.0, jnp.asarray(a), 0.5, jnp.asarray(c), grid22, uplo="lower"))
-        ref = _tri_ref("lower", a @ np.conj(a).T + 0.5 * c, c)
+        # her*k BLAS semantics: C's Hermitian diagonal is treated as real —
+        # any stray imaginary part is dropped before beta scales it
+        creal = c.copy()
+        np.fill_diagonal(creal, np.real(np.diag(c)))
+        ref = _tri_ref("lower", a @ np.conj(a).T + 0.5 * creal, c)
         np.testing.assert_allclose(out, ref, atol=1e-10)
 
     def test_syr2k(self, grid24, rng):
@@ -69,7 +73,9 @@ class TestRankK:
             alpha, jnp.asarray(a), jnp.asarray(b), 2.0, jnp.asarray(c),
             grid22, uplo="upper"))
         upd = alpha * a @ np.conj(b).T + np.conj(alpha) * b @ np.conj(a).T
-        ref = _tri_ref("upper", upd + 2.0 * c, c)
+        creal = c.copy()                     # her*k semantics: real diagonal
+        np.fill_diagonal(creal, np.real(np.diag(c)))
+        ref = _tri_ref("upper", upd + 2.0 * creal, c)
         np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
@@ -153,6 +159,13 @@ class TestBandDistributed:
             1.0, jnp.asarray(a), jnp.asarray(b), 0.0, jnp.asarray(c), grid22,
             kd=kd, uplo="lower"))
         np.testing.assert_allclose(out, full @ b, atol=1e-10)
+        # right side (the reference's Side parameter, slate.hh:215)
+        br = np.conj(b).T                                  # (5, n)
+        out_r = np.asarray(hbmm_distributed(
+            1.0, jnp.asarray(a), jnp.asarray(br), 0.0,
+            jnp.asarray(np.zeros((5, n), complex)), grid22,
+            kd=kd, uplo="lower", side="right"))
+        np.testing.assert_allclose(out_r, br @ full, atol=1e-10)
 
 
 class TestScalapackSkin:
