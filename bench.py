@@ -343,8 +343,9 @@ def child_norm(cpu_fallback):
     # the metric models the *fro job* (2n^2) over half the per-iter time
     # (two same-cost bandwidth-bound passes), keeping it comparable to dlange
     ks, kl = (2, 6) if cpu_fallback else (4, 20)
+    # each iter = fro + one (two same-cost bandwidth-bound passes); the fro
+    # job model is 2n^2 flops over half the iter time, i.e. 4n^2 per iter
     gflops, per_iter = _chain_rate(body, c0, (a,), ks, kl, 2.0 * 2.0 * n * n)
-    gflops /= 2.0
     _emit({"metric": f"genorm_fro_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter,
            "note": "fro+one per iter; rate = fro model over half iter time"})
@@ -521,9 +522,10 @@ def main():
             elif res.get("error"):
                 summary[name]["fresh_error"] = res.get("error")
         elif res.get("ok"):
+            # CPU-fallback number with no TPU history: NOT hardware evidence
             summary[name] = {"metric": res.get("metric"), "value": res.get("value"),
                              "vs_baseline": res.get("vs_baseline"),
-                             "backend": res.get("backend"), "source": "fresh"}
+                             "backend": res.get("backend"), "source": "cpu-only"}
         else:
             summary[name] = {"error": res.get("error")}
     head = summary.get(HEADLINE, {})
