@@ -572,12 +572,17 @@ def _gmres_ir(matvec, precond, b, opts, routine: str):
     _require_single_rhs(b, routine)
     bv = b.reshape(-1) if not squeeze else b
     n = bv.shape[0]
-    eps = jnp.finfo(bv.dtype).eps
-    tol = (opts.tolerance if opts.tolerance is not None
-           else float(eps) * (n ** 0.5)) * float(jnp.linalg.norm(bv))
+    eps = jnp.finfo(jnp.real(bv).dtype).eps
+    # tolerance stays traced: the whole GMRES-IR (restart loop included — it
+    # is a lax.while_loop in _fgmres) dispatches with zero device→host round
+    # trips; callers sync exactly once on the returned verdict
+    tol = jnp.asarray(
+        opts.tolerance if opts.tolerance is not None
+        else float(eps) * (n ** 0.5),
+        jnp.real(bv).dtype) * jnp.linalg.norm(bv)
     x, restarts = _fgmres(matvec, precond, bv, precond(bv), restart=min(30, n),
                           tol=tol, max_restarts=opts.max_iterations // 10 + 1)
-    resid = float(jnp.linalg.norm(bv - matvec(x)))
+    resid = jnp.linalg.norm(bv - matvec(x))
     converged = resid <= tol * 10        # NaN residual fails this, forcing fallback
     return (x if squeeze else x[:, None]), restarts, converged
 

@@ -134,16 +134,21 @@ def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
     from .solvers import potrf_distributed, trsm_distributed
 
     L = potrf_distributed(B, grid, nb=max(nb, 32))
-    if not bool(jnp.all(jnp.isfinite(jnp.diagonal(L)))):
-        raise SlateError("hegv_distributed: B not positive definite")
+    # SPD verdict stays traced until the END: the whole pipeline (transform,
+    # eigensolve, back-transform — all bounded loops, NaN-safe) dispatches
+    # with a single host sync, instead of blocking on L's diagonal up front
+    spd_ok = jnp.all(jnp.isfinite(jnp.diagonal(L)))
     C = hegst(itype, _shard(A, grid), L)
     lam, Z = heev_distributed(C, grid, nb=nb, want_vectors=want_vectors)
-    if not want_vectors:
-        return lam, None
-    if itype in (1, 2):
-        X = trsm_distributed(L, Z, grid, lower=True, conj_trans=True)
+    if want_vectors:
+        if itype in (1, 2):
+            X = trsm_distributed(L, Z, grid, lower=True, conj_trans=True)
+        else:
+            X = jnp.matmul(jnp.tril(L), Z, precision=lax.Precision.HIGHEST)
     else:
-        X = jnp.matmul(jnp.tril(L), Z, precision=lax.Precision.HIGHEST)
+        X = None
+    if not bool(spd_ok):                  # the solve's single host sync
+        raise SlateError("hegv_distributed: B not positive definite")
     return lam, X
 
 

@@ -333,11 +333,11 @@ def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
 
     X, iters, ok = _ir_refine_distributed(A, B, solve_lo, grid,
                                           max_iterations)
-    if not ok or not bool(jnp.all(jnp.isfinite(X))):
+    if not bool(ok):                      # the solve's single host sync
         LU, perm, info = getrf_distributed(A, grid, nb=nb)
-        return (getrs_distributed(LU, perm, B, grid), perm, info, iters,
+        return (getrs_distributed(LU, perm, B, grid), perm, info, int(iters),
                 False)
-    return X, perm, info, iters, True
+    return X, perm, info, int(iters), True
 
 
 def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,

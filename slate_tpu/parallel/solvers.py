@@ -208,29 +208,46 @@ def _lower_dtype(dt):
 
 def _ir_refine_distributed(Af, B, solve_lo, grid, max_iterations, tol=None):
     """Working-precision iterative refinement around a low-precision sharded
-    solve (the gesv_mixed.cc loop over the mesh).  The per-iteration residual
-    norm check is one scalar fetch — the same cadence as the reference's
-    MPI-reduced norm per iteration."""
+    solve (the gesv_mixed.cc loop over the mesh), expressed as ONE
+    ``lax.while_loop``: the residual-norm convergence check rides the loop
+    carry instead of a per-iteration device→host fetch, so the whole
+    refinement dispatches without a single round trip (the reference's
+    MPI-reduced norm per iteration has no host in the loop either).
+
+    Returns traced ``(X, iters, ok)`` with ``ok = converged & all-finite(X)``;
+    callers sync once on ``ok``.
+    """
     dt = jnp.dtype(Af.dtype)
     eps = float(jnp.finfo(
         dt if jnp.issubdtype(dt, jnp.floating)
         else (jnp.float64 if dt == jnp.complex128 else jnp.float32)).eps)
     n = Af.shape[-1]
     tol = tol if tol is not None else eps * (n ** 0.5)
-    anorm = float(jnp.max(jnp.sum(jnp.abs(Af), axis=-1)))
-    X = solve_lo(B).astype(B.dtype)
-    it = 0
-    converged = False
-    while it < max_iterations:
+    anorm = jnp.max(jnp.sum(jnp.abs(Af), axis=-1))
+    rdt = jnp.finfo(anorm.dtype)
+
+    def residual(X):
         R = B - jnp.matmul(Af, X, precision=lax.Precision.HIGHEST)
-        rnorm = float(jnp.max(jnp.abs(R)))
-        xnorm = float(jnp.max(jnp.abs(X)))
-        if rnorm <= tol * anorm * max(xnorm, 1e-300):
-            converged = True
-            break
+        good = jnp.max(jnp.abs(R)) <= tol * anorm * jnp.maximum(
+            jnp.max(jnp.abs(X)), jnp.asarray(rdt.tiny, anorm.dtype))
+        return R, good
+
+    X0 = solve_lo(B).astype(B.dtype)
+    R0, good0 = residual(X0)
+
+    def cond(carry):
+        _X, _R, it, done = carry
+        return (~done) & (it < max_iterations)
+
+    def body(carry):
+        X, R, it, _ = carry
         X = X + solve_lo(R).astype(B.dtype)
-        it += 1
-    return X, it, converged
+        R, good = residual(X)
+        return X, R, it + 1, good
+
+    X, _R, it, done = lax.while_loop(cond, body,
+                                     (X0, R0, jnp.int32(0), good0))
+    return X, it, done & jnp.all(jnp.isfinite(X))
 
 
 def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
@@ -255,9 +272,9 @@ def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
 
     X, iters, ok = _ir_refine_distributed(Af, B, solve_lo, grid,
                                           max_iterations)
-    if not ok or not bool(jnp.all(jnp.isfinite(X))):
-        return posv_distributed(Af, B, grid, nb=nb), iters, False
-    return X, iters, True
+    if not bool(ok):                      # the solve's single host sync
+        return posv_distributed(Af, B, grid, nb=nb), int(iters), False
+    return X, int(iters), True
 
 
 def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
