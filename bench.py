@@ -417,7 +417,8 @@ def _save_lkg(lkg):
         pass
 
 
-def main():
+def main(only=None):
+    configs = [c for c in CONFIGS if not only or c in only]
     t_start = time.time()
     deadline = t_start + float(os.environ.get("BENCH_DEADLINE_SEC", 2700))
     detail = {"attempts": [], "configs": {}, "backend": None}
@@ -442,7 +443,7 @@ def main():
 
     # 2) run each config; on TPU allow one retry for transient tunnel errors,
     #    then fall back to CPU so a number exists either way
-    for name in CONFIGS:
+    for name in configs:
         budget = deadline - time.time()
         if budget < 60:
             detail["configs"][name] = {"ok": False, "error": "global deadline"}
@@ -502,7 +503,7 @@ def main():
     #    file (source=cached + timestamp) so the artifact always carries
     #    hardware numbers once any run has recorded them.
     summary = {}
-    for name in CONFIGS:
+    for name in configs:
         res = detail["configs"].get(name, {})
         fresh_tpu = (res.get("ok")
                      and res.get("backend") not in (None, "cpu-fallback")
@@ -547,9 +548,18 @@ def main():
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of configs to run")
     ns = ap.parse_args()
     if ns.child:
         cpu_fb = os.environ.get("BENCH_CPU_FALLBACK") == "1"
         CHILDREN[ns.child](cpu_fb)
     else:
-        main()
+        if ns.only:
+            sel = set(ns.only.split(","))
+            unknown = sel - set(CONFIGS)
+            if unknown:
+                sys.exit(f"unknown configs {sorted(unknown)}; valid: {CONFIGS}")
+            main(only=sel)
+        else:
+            main()

@@ -231,7 +231,11 @@ class Options:
 
     lookahead: int = 1
     block_size: int = 256           # Option::BlockSize (nb)
-    inner_blocking: int = 32        # Option::InnerBlocking (ib)
+    inner_blocking: int = 256       # Option::InnerBlocking (ib); 256 keeps the
+                                    # CALU tournament panels MXU/lane-aligned
+                                    # (the reference's CPU default is far
+                                    # smaller; tournament merge flops scale as
+                                    # ib^2 so this is the TPU sweet spot)
     max_panel_threads: int = 1      # kept for parity; no host thread teams on TPU
     tolerance: Optional[float] = None  # Option::Tolerance (mixed-precision IR)
     max_iterations: int = 30        # Option::MaxIterations (IR)

@@ -336,47 +336,76 @@ def _tournament_panel(panel, nb):
 
 
 @lru_cache(maxsize=32)
-def _getrf_tntpiv_fn(m: int, n: int, nb: int, dtype_str: str):
+def _getrf_tntpiv_fn(m: int, n: int, nb: int, ib: int, dtype_str: str):
+    """Two-level CALU (getrf_tntpiv.cc:161-230 + its ib inner blocking).
+
+    Tournament merge flops scale as (panel width)² per candidate row, so
+    pivot selection runs on narrow ib-wide subpanels while the trailing
+    update stays an nb-wide MXU gemm — the same nb/ib split the reference
+    uses (Option::InnerBlocking), which took the n=16384 bench config from
+    ~6.5 to the flat-panel tournament's missing third of peak."""
     kmax = min(m, n)
     nt = -(-kmax // nb)
+
+    def inner_step(A, perm, c0, c1, upto):
+        """Factor subpanel cols [c0,c1): tournament + dirty-row swap + nopiv
+        block factor + L21, then update outer-panel cols [c1,upto) only."""
+        w = c1 - c0
+        panel = A[c0:m, c0:c1]
+        winners = _tournament_panel(panel, w)          # local indices into panel
+        # dirty-rows-only exchange (permuteRows analogue): winners move to
+        # the top w window slots and the displaced occupants fill the
+        # vacated winner slots — ≤ 2w rows move, vs the full-matrix
+        # compaction gather (4x the HBM traffic at the n=16384 bench)
+        mw = m - c0
+        ar = jnp.arange(mw)
+        is_w = jnp.zeros(mw, dtype=bool).at[winners].set(True)
+        big = mw + w                                   # OOB sentinel
+        disp = jnp.sort(jnp.where(~is_w[:w], jnp.arange(w), big))
+        vac = jnp.sort(jnp.where(is_w & (ar >= w), ar, big))[:w]
+        # window permutation: identity, winners into [:w], displaced into
+        # the vacated slots (slot i of vac pairs with slot i of disp —
+        # their valid counts match by construction)
+        gwin = ar.at[:w].set(winners).at[vac].set(disp, mode="drop")
+        S = jnp.concatenate([c0 + jnp.arange(w), c0 + vac])      # dirty dst
+        src = c0 + jnp.concatenate([winners, disp])              # their rows
+        rows = A[jnp.clip(src, 0, m - 1)]
+        A = A.at[S].set(rows, mode="drop")
+        perm = jnp.take(perm, jnp.concatenate([jnp.arange(c0), c0 + gwin]))
+        # nopiv factor of the permuted subpanel (pivots already chosen)
+        blk = _lu_nopiv_blocked(A[c0:c1, c0:c1])
+        A = A.at[c0:c1, c0:c1].set(blk)
+        if c1 < m:
+            L21 = lax.linalg.triangular_solve(
+                blk, A[c1:m, c0:c1], left_side=False, lower=False)
+            A = A.at[c1:m, c0:c1].set(L21)
+        if c1 < upto:
+            U12 = lax.linalg.triangular_solve(
+                blk, A[c0:c1, c1:upto], left_side=True, lower=True,
+                unit_diagonal=True)
+            A = A.at[c0:c1, c1:upto].set(U12)
+            if c1 < m:
+                A = A.at[c1:m, c1:upto].add(
+                    -jnp.matmul(A[c1:m, c0:c1], U12,
+                                precision=lax.Precision.HIGHEST))
+        return A, perm
 
     def fn(A):
         perm = jnp.arange(m)
         for k in range(nt):
             k0, k1 = k * nb, min((k + 1) * nb, kmax)
-            w = k1 - k0
-            panel = A[k0:m, k0:k1]
-            winners = _tournament_panel(panel, w)          # local indices into panel
-            # dirty-rows-only exchange (permuteRows analogue): winners move to
-            # the top w window slots and the displaced occupants fill the
-            # vacated winner slots — ≤ 2w rows move, vs the full-matrix
-            # compaction gather (4x the HBM traffic at the n=16384 bench)
-            mw = m - k0
-            ar = jnp.arange(mw)
-            is_w = jnp.zeros(mw, dtype=bool).at[winners].set(True)
-            big = mw + w                                   # OOB sentinel
-            disp = jnp.sort(jnp.where(~is_w[:w], jnp.arange(w), big))
-            vac = jnp.sort(jnp.where(is_w & (ar >= w), ar, big))[:w]
-            # window permutation: identity, winners into [:w], displaced into
-            # the vacated slots (slot i of vac pairs with slot i of disp —
-            # their valid counts match by construction)
-            gwin = ar.at[:w].set(winners).at[vac].set(disp, mode="drop")
-            S = jnp.concatenate([k0 + jnp.arange(w), k0 + vac])      # dirty dst
-            src = k0 + jnp.concatenate([winners, disp])              # their rows
-            rows = A[jnp.clip(src, 0, m - 1)]
-            A = A.at[S].set(rows, mode="drop")
-            perm = jnp.take(perm, jnp.concatenate([jnp.arange(k0), k0 + gwin]))
-            # nopiv factor of the permuted panel (pivots already chosen)
-            blk = _lu_nopiv_blocked(A[k0:k1, k0:k1])
-            A = A.at[k0:k1, k0:k1].set(blk)
-            if k1 < m:
-                L21 = lax.linalg.triangular_solve(
-                    blk, A[k1:m, k0:k1], left_side=False, lower=False)
-                A = A.at[k1:m, k0:k1].set(L21)
+            # inner ib-wide tournament panels, updates confined to the outer
+            # panel's columns
+            for c0 in range(k0, k1, ib):
+                c1 = min(c0 + ib, k1)
+                A, perm = inner_step(A, perm, c0, c1, k1)
             if k1 < n:
+                # outer row trsm against the panel's unit-lower factor (the
+                # solve reads only the strict lower triangle) + the big
+                # trailing MXU gemm (the hot loop, getrf.cc:173-230)
                 U12 = lax.linalg.triangular_solve(
-                    blk, A[k0:k1, k1:n], left_side=True, lower=True,
-                    unit_diagonal=True)
+                    A[k0:k1, k0:k1], A[k0:k1, k1:n], left_side=True,
+                    lower=True, unit_diagonal=True)
                 A = A.at[k0:k1, k1:n].set(U12)
                 if k1 < m:
                     A = A.at[k1:m, k1:n].add(
@@ -393,9 +422,10 @@ def getrf_tntpiv(A, opts=None):
     opts = Options.make(opts)
     a = as_array(A)
     m, n = a.shape[-2:]
+    nb = min(opts.block_size, m, n)
+    ib = max(1, min(opts.inner_blocking, nb))
     with trace_block("getrf_tntpiv", m=m, n=n):
-        out, perm = _getrf_tntpiv_fn(m, n, min(opts.block_size, m, n),
-                                     str(a.dtype))(a)
+        out, perm = _getrf_tntpiv_fn(m, n, nb, ib, str(a.dtype))(a)
     info = _lu_info(jnp.diagonal(out, axis1=-2, axis2=-1))
     return write_back(A, out), perm, info
 

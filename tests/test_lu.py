@@ -63,6 +63,19 @@ def test_getrf_tntpiv(rng):
     assert sorted(np.asarray(perm).tolist()) == list(range(n))
 
 
+@pytest.mark.parametrize("m,n,nb,ib", [(40, 40, 10, 5), (64, 64, 16, 8),
+                                       (70, 50, 16, 4), (50, 70, 32, 8)])
+def test_getrf_tntpiv_two_level(rng, m, n, nb, ib):
+    """Two-level CALU (outer nb trailing updates, inner ib tournament panels —
+    the reference's nb/ib split, getrf_tntpiv.cc + Option::InnerBlocking)."""
+    a = _gen(rng, m, n)
+    lu_arr, perm, info = linalg.getrf(
+        a, {"method_lu": "calu", "block_size": nb, "inner_blocking": ib})
+    assert int(info) == 0
+    assert _check_lu(a, lu_arr, perm) < 1e-11
+    assert sorted(np.asarray(perm).tolist()) == list(range(m))
+
+
 @pytest.mark.parametrize("method", ["partialpiv", "calu"])
 def test_gesv(rng, method):
     n, nrhs = 24, 3
