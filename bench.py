@@ -422,6 +422,14 @@ def main(only=None):
     t_start = time.time()
     deadline = t_start + float(os.environ.get("BENCH_DEADLINE_SEC", 2700))
     detail = {"attempts": [], "configs": {}, "backend": None}
+    if only:
+        # subset runs refresh their own configs in BENCH_DETAIL.json without
+        # dropping the others' recorded history
+        try:
+            with open(DETAIL_PATH) as f:
+                detail["configs"] = json.load(f).get("configs", {})
+        except (OSError, json.JSONDecodeError, AttributeError):
+            pass
     lkg = _load_lkg()
 
     # 1) probe the TPU backend with bounded retries (fresh process each try).
@@ -502,8 +510,11 @@ def main(only=None):
     #    failed or fell back to CPU are backfilled from the last-known-good
     #    file (source=cached + timestamp) so the artifact always carries
     #    hardware numbers once any run has recorded them.
+    # summarize ALL configs regardless of --only: un-run configs backfill from
+    # the last-known-good file, so the one-line artifact (headline included)
+    # never shrinks or nulls out because of a subset run
     summary = {}
-    for name in configs:
+    for name in CONFIGS:
         res = detail["configs"].get(name, {})
         fresh_tpu = (res.get("ok")
                      and res.get("backend") not in (None, "cpu-fallback")

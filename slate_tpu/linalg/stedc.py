@@ -69,25 +69,27 @@ def _secular_roots(d: jax.Array, z2: jax.Array, rho: jax.Array):
     width = rho * znorm2 + eps * (jnp.abs(d[-1]) + 1)
     gaps = jnp.concatenate([d[1:] - d[:-1], width[None]])
     d_up = jnp.concatenate([d[1:], (d[-1] + width)[None]])  # upper pole per bracket
-    Dlo = d[:, None] - d[None, :]            # (i, j): d_i - d_j
-    Dup = d[:, None] - d_up[None, :]         # (i, j): d_i - d_{j+1}
 
-    def f_of_t(t):      # f at lam = d_j + t
-        return 1.0 + rho * jnp.sum(z2[:, None] / (Dlo - t[None, :]), axis=0)
+    # pole-relative evaluation, fused: the (m, m) denominator is built inside
+    # the reduction as (d_i - pole_j) - off_j — the two-term form keeps the
+    # laed4 relative precision of the gap (pole subtracted exactly first),
+    # while XLA fuses broadcast→divide→reduce so no m×m buffer survives a
+    # sweep (the round-2 version cached Dlo/Dup/D_sel: 3 m² arrays that made
+    # the n=20,000 merge memory-infeasible)
+    def f_at(pole, off):     # f(lam_j = pole_j + off_j) for all brackets j
+        den = (d[:, None] - pole[None, :]) - off[None, :]
+        return 1.0 + rho * jnp.sum(z2[:, None] / den, axis=0)
 
     # closer-pole selection: f increasing per bracket; f(mid) >= 0 -> root in
     # the lower half (solve in u = lam - d_j), else upper (u = d_{j+1} - lam)
-    use_lower = f_of_t(0.5 * gaps) >= 0
+    use_lower = f_at(d, 0.5 * gaps) >= 0
     sigma = jnp.where(use_lower, 1.0, -1.0).astype(d.dtype)
-    # pole-relative matrix per bracket: lam_j = pole_j + sigma_j * u_j, so the
-    # secular denominators are D_sel - sigma*u — one bisection serves both sides
-    D_sel = jnp.where(use_lower[None, :], Dlo, Dup)
+    pole = jnp.where(use_lower, d, d_up)
 
     def body(_, lohi):
         lo, hi = lohi
         u = 0.5 * (lo + hi)
-        f = 1.0 + rho * jnp.sum(
-            z2[:, None] / (D_sel - (sigma * u)[None, :]), axis=0)
+        f = f_at(pole, sigma * u)
         bigger = sigma * f < 0               # root at larger u
         lo = jnp.where(bigger, u, lo)
         hi = jnp.where(bigger, hi, u)
@@ -152,8 +154,11 @@ def _merge(d1, Q1, d2, Q2, rho_raw):
     absM = jnp.abs(M)
     num = jnp.sum(jnp.log(jnp.where(absM > 0, absM, 1.0)), axis=1)
     zero_num = jnp.any(absM == 0, axis=1)
-    Dabs = jnp.abs(d[:, None] - d[None, :]) + jnp.eye(m, dtype=dt)
-    den = jnp.sum(jnp.log(Dabs), axis=1)
+    # denominator log-sum over |d_j - d_i| (i≠j), fused broadcast reduction —
+    # no m×m Dabs buffer (memory diet, same reason as in _secular_roots)
+    same = idx[:, None] == idx[None, :]
+    den = jnp.sum(jnp.log(jnp.where(same, 1.0,
+                                    jnp.abs(d[:, None] - d[None, :]))), axis=1)
     sign_z = jnp.where(z >= 0, 1.0, -1.0).astype(dt)  # sign(0) must be 1, not 0
     ztilde = jnp.where(zero_num, 0.0, sign_z * jnp.exp(0.5 * (num - den)))
 

@@ -530,8 +530,35 @@ def unmbr_tb2bd(side, op, Q, C, opts=None):
 
 def bdsqr(d, e, opts=None, want_vectors: bool = False):
     """Bidiagonal SVD (src/bdsqr.cc wraps lapack::bdsqr, svd.cc:354-359).
-    Assembles the bidiagonal and runs the fused XLA SVD."""
+
+    Values-only at scale: Sturm bisection on the Golub–Kahan form — the
+    2k×2k symmetric tridiagonal with zero diagonal and interleaved
+    (d_0, e_0, d_1, e_1, …) off-diagonal, whose eigenvalues are ±σ_i (the
+    bdsvdx/stebz route in LAPACK).  O(k²) lane-parallel work, O(k) memory,
+    and no squaring of the condition number (unlike the B^T B normal form).
+    Small problems and the vectors path assemble B and run the fused XLA SVD.
+
+    Accuracy envelope: like LAPACK's bisection (stebz/bdsvdx), the large-k
+    values path delivers *absolute* accuracy O(eps·σ_max); singular values
+    near σ_max·eps therefore carry no relative digits (bdsqr's QR iteration
+    is relatively accurate there).  Callers needing full relative accuracy
+    of tiny σ at k > _STEV_DENSE_MAX should take the vectors path.
+    """
+    from .eig import _STEV_DENSE_MAX
+
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
     k = d.shape[-1]
+    if not want_vectors and k > _STEV_DENSE_MAX:
+        from .sturm import sterf_bisect
+
+        tgk_off = jnp.zeros((2 * k - 1,), d.dtype)
+        tgk_off = tgk_off.at[0::2].set(d)
+        if k > 1:
+            tgk_off = tgk_off.at[1::2].set(e)
+        lam = sterf_bisect(jnp.zeros((2 * k,), d.dtype), tgk_off)
+        # +σ branch, descending; clamp the ~eps·||B|| bisection noise at σ≈0
+        return jnp.maximum(lam[k:][::-1], 0.0), None, None
     B = jnp.zeros((k, k), dtype=d.dtype)
     idx = jnp.arange(k)
     B = B.at[idx, idx].set(d)

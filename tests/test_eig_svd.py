@@ -161,3 +161,49 @@ def test_ge2tb_complex(rng):
     assert np.linalg.norm(recon - a) / np.linalg.norm(a) < 1e-12
     np.testing.assert_allclose(np.asarray(linalg.bdsqr(d, e)[0]),
                                np.linalg.svd(a, compute_uv=False), rtol=1e-9)
+
+
+def test_sterf_bisection_large(rng):
+    """O(n²) Sturm bisection path (linalg/sturm.py) past the dense-eigh
+    threshold, against the assembled-tridiagonal reference."""
+    n = 600
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    ref = np.linalg.eigvalsh(T)
+    got = np.asarray(linalg.sterf(jnp.asarray(d), jnp.asarray(e)))
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(got - ref)) / scale < 1e-13
+    # heavily clustered spectrum stays pinned
+    dc = np.repeat(np.arange(6.0), 100)
+    ec = np.full(n - 1, 1e-13)
+    refc = np.linalg.eigvalsh(np.diag(dc) + np.diag(ec, 1) + np.diag(ec, -1))
+    gotc = np.asarray(linalg.sterf(jnp.asarray(dc), jnp.asarray(ec)))
+    assert np.max(np.abs(gotc - refc)) < 1e-12
+
+
+def test_steqr_large_routes_dc(rng):
+    """steqr above the dense threshold routes to the D&C solver and keeps the
+    (ascending lam, Z @ Q) contract."""
+    n = 560
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    lam, Q = linalg.steqr(jnp.asarray(d), jnp.asarray(e))
+    lam, Q = np.asarray(lam), np.asarray(Q)
+    assert np.all(np.diff(lam) >= 0)
+    assert np.max(np.abs(T @ Q - Q * lam[None, :])) < 1e-11
+    assert np.max(np.abs(Q.T @ Q - np.eye(n))) < 1e-11
+
+
+def test_bdsqr_tgk_values_large(rng):
+    """Values-only bdsqr at scale: Golub–Kahan tridiagonal + Sturm bisection
+    (no dense k×k SVD), descending like the fused path."""
+    k = 520
+    d = np.abs(rng.standard_normal(k)) + 0.1
+    e = rng.standard_normal(k - 1)
+    B = np.diag(d) + np.diag(e, 1)
+    ref = np.linalg.svd(B, compute_uv=False)
+    got = np.asarray(linalg.bdsqr(jnp.asarray(d), jnp.asarray(e))[0])
+    assert np.max(np.abs(got - ref)) / ref[0] < 1e-13
+    assert np.all(got >= 0) and np.all(np.diff(got) <= 0)
