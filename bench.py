@@ -516,7 +516,10 @@ def main(only=None):
     summary = {}
     for name in CONFIGS:
         res = detail["configs"].get(name, {})
-        fresh_tpu = (res.get("ok")
+        # preloaded entries from a prior run's BENCH_DETAIL.json are never
+        # "fresh" — only configs actually run this session qualify; the rest
+        # backfill from the LKG file with their recorded timestamp
+        fresh_tpu = (name in configs and res.get("ok")
                      and res.get("backend") not in (None, "cpu-fallback")
                      and not str(res.get("backend", "")).startswith("cpu"))
         if fresh_tpu:

@@ -248,14 +248,13 @@ def getrf(A, opts=None):
 
     grid = distribution_grid(A)
     a_chk = as_array(A)
-    if grid is not None and a_chk.shape[-2] <= 2 * a_chk.shape[-1]:
+    if grid is not None:
         # wrapper bound to a >1-device grid: tournament-pivoted distributed LU
         # (the mesh form of getrf_tntpiv; reference getrf.cc consumes the
         # construction-time distribution the same way).  Wide inputs factor the
-        # leading square block + one sharded trsm; moderately tall inputs embed
-        # into a square problem inside getrf_distributed; very tall panels
-        # (m > 2n: the O(m^3) embedding would dwarf the O(m n^2) job) fall
-        # through to the single-device path.
+        # leading square block + one sharded trsm; tall inputs ride the 1-D
+        # TSLU (O(m n²/P); the round-2 square embedding and its m <= 2n
+        # caller guard are gone).
         from ..parallel import getrf_distributed
 
         lu_, perm, info = getrf_distributed(a_chk, grid, nb=opts.block_size)

@@ -22,7 +22,8 @@ from .blas3_dist import (herk_distributed, syrk_distributed, her2k_distributed,
 from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
                       posv_mixed_distributed, posv_mixed_gmres_distributed,
                       cholqr_distributed, gels_cholqr_distributed)
-from .lu_dist import (getrf_distributed, getrs_distributed, gesv_distributed,
+from .lu_dist import (getrf_distributed, getrf_tall_distributed,
+                      getrs_distributed, gesv_distributed,
                       gesv_mixed_distributed, gesv_mixed_gmres_distributed)
 from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       geqrf_distributed, gels_caqr_distributed)

@@ -212,6 +212,212 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@lru_cache(maxsize=32)
+def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
+    """Jitted 1-D TSLU over an mpad×npc tall matrix: rows block-sharded over
+    the *flattened* mesh (every device owns all columns), tournament panels
+    over the flat axis, trailing updates as fully local MXU gemms.
+
+    The reference's ``src/getrf.cc:22-260`` factors any m×n over the grid;
+    this is its tall regime re-shaped for TPU: with columns local, the panel
+    needs no column broadcast at all, and the only collectives per panel are
+    the candidate all-gather (tournament, getrf_tntpiv.cc) and two masked
+    psums (dirty-row exchange + U row-band broadcast) — O(nb·(P·nb + npc))
+    bytes each.  Work is O(m n²/P): the square-embedding detour (round 2) and
+    its O(m³) flops are gone.
+    """
+    AX = (ROW_AXIS, COL_AXIS)                  # flattened device axis
+    nprocs = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    mr = mpad // nprocs
+    nt = npc // nb
+    assert mr % nb == 0
+
+    def local_fn(A_loc):                       # (mr, npc) per device
+        ri = lax.axis_index(AX)
+        grow = ri * mr + jnp.arange(mr, dtype=jnp.int32)
+        gcol = jnp.arange(npc, dtype=jnp.int32)
+
+        def step(k, carry):
+            A_loc, perm = carry
+            k0 = (k * nb).astype(jnp.int32) if hasattr(k, "astype") else k * nb
+
+            # ---- tournament round 1: local candidates over my rows
+            pan = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
+            cand_ok = grow >= k0
+            panm = jnp.where(cand_ok[:, None], pan, jnp.zeros_like(pan))
+            _, _, perm_loc = lax.linalg.lu(panm)
+            sel = perm_loc[:nb]
+            cand_rows = pan[sel]
+            cand_idx = jnp.where(cand_ok[sel], grow[sel], jnp.int32(-1))
+            cand_rows = jnp.where((cand_idx >= 0)[:, None], cand_rows,
+                                  jnp.zeros_like(cand_rows))
+
+            # ---- round 2: stacked LU over the gathered candidates
+            C = lax.all_gather(cand_rows, AX).reshape(nprocs * nb, nb)
+            I = lax.all_gather(cand_idx, AX).reshape(nprocs * nb)
+            _, _, pfin = lax.linalg.lu(C)
+            piv = I[pfin[:nb]]
+            piv = jnp.where(piv >= k0, piv,
+                            k0 + jnp.arange(nb, dtype=jnp.int32))
+
+            # ---- sequential-swap step permutation (ipiv-compatible)
+            def swap_body(i, sp_spos):
+                sp, spos = sp_spos
+                a = k0 + i
+                b = spos[piv[i]]
+                ra, rb = sp[a], sp[b]
+                sp = sp.at[a].set(rb).at[b].set(ra)
+                spos = spos.at[rb].set(a).at[ra].set(b)
+                return sp, spos
+
+            iota = jnp.arange(mpad, dtype=jnp.int32)
+            stepperm, _ = lax.fori_loop(0, nb, swap_body, (iota, iota))
+            perm = perm[stepperm]
+
+            # ---- dirty-row exchange (≤ 2nb rows move, full local width)
+            S = jnp.concatenate([k0 + jnp.arange(nb, dtype=jnp.int32), piv])
+            src = stepperm[S]
+            loc = src - ri * mr
+            own = (loc >= 0) & (loc < mr)
+            rows = A_loc[jnp.clip(loc, 0, mr - 1)]
+            rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
+            rows = lax.psum(rows, AX)          # (2nb, npc) everywhere
+            dst = S - ri * mr
+            dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)
+            A_loc = A_loc.at[dst].set(rows, mode="drop")
+
+            # ---- diagonal block factor (rows [k0,k0+nb) live on device po)
+            po = k0 // mr
+            roff = k0 - po * mr
+            pan2 = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
+            blk = lax.dynamic_slice(pan2, (roff, jnp.int32(0)), (nb, nb))
+            blk = jnp.where(ri == po, blk, jnp.zeros_like(blk))
+            blk = lax.psum(blk, AX)
+            LUkk, _, blkperm = lax.linalg.lu(blk)
+            # fold intra-block pivots into the global permutation + reorder
+            seg = jnp.take(perm, k0 + blkperm)
+            perm = lax.dynamic_update_slice(perm, seg, (k0,))
+            blk_rows = A_loc[jnp.clip(roff + blkperm, 0, mr - 1)]
+            A_perm = lax.dynamic_update_slice(A_loc, blk_rows,
+                                              (roff, jnp.int32(0)))
+            A_loc = jnp.where(ri == po, A_perm, A_loc)
+            pan2 = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
+
+            # ---- panel L: X = pan · Ukk^{-1} for rows below the block
+            Ukk = jnp.triu(LUkk)
+            X = lax.linalg.triangular_solve(Ukk, pan2, left_side=False,
+                                            lower=False)
+            below = grow >= (k0 + nb)
+            Lmask = jnp.where(below[:, None], X, jnp.zeros_like(X))
+            in_blk = (grow >= k0) & (grow < k0 + nb)
+            packed = jnp.where(in_blk[:, None],
+                               lax.dynamic_update_slice(
+                                   jnp.zeros((mr, nb), pan2.dtype), LUkk,
+                                   (roff, jnp.int32(0))),
+                               jnp.where(below[:, None], Lmask, pan2))
+            A_loc = lax.dynamic_update_slice(A_loc, packed, (jnp.int32(0), k0))
+
+            # ---- U row band (owner bcast) + masked trailing columns
+            rb = lax.dynamic_slice(A_loc, (roff, jnp.int32(0)), (nb, npc))
+            rb = jnp.where(ri == po, rb, jnp.zeros_like(rb))
+            rb = lax.psum(rb, AX)              # (nb, npc) everywhere
+            U_band = lax.linalg.triangular_solve(jnp.tril(LUkk), rb,
+                                                 left_side=True, lower=True,
+                                                 unit_diagonal=True)
+            ucols = gcol >= (k0 + nb)
+            Umask = jnp.where(ucols[None, :], U_band, jnp.zeros_like(U_band))
+            new_rows = jnp.where(ucols[None, :], U_band, rb)
+            rowband = lax.dynamic_update_slice(A_loc, new_rows,
+                                               (roff, jnp.int32(0)))
+            A_loc = jnp.where(ri == po, rowband, A_loc)
+
+            # ---- trailing update: one fully local MXU gemm
+            A_loc = A_loc - jnp.matmul(Lmask, Umask,
+                                       precision=lax.Precision.HIGHEST)
+            return A_loc, perm
+
+        perm0 = jnp.arange(mpad, dtype=jnp.int32)
+        A_loc, perm = lax.fori_loop(0, nt, step, (A_loc, perm0))
+
+        # info: first zero diagonal of U (cols ∩ my rows, psum-assembled)
+        on_diag = (grow[:, None] == gcol[None, :])
+        drow = jnp.sum(jnp.where(on_diag, A_loc, jnp.zeros_like(A_loc)),
+                       axis=1)
+        in_range = grow < npc
+        diag = jnp.zeros((npc,), A_loc.dtype).at[
+            jnp.where(in_range, grow, npc)].add(
+                jnp.where(in_range, drow, jnp.zeros_like(drow)), mode="drop")
+        diag = lax.psum(diag, AX)
+        info = jnp.where(jnp.any(diag == 0),
+                         jnp.argmax(diag == 0).astype(jnp.int32) + 1,
+                         jnp.int32(0))
+        return A_loc, perm, info
+
+    spec = P(AX, None)
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=spec,
+                       out_specs=(spec, P(None), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
+    """1-D TSLU for tall matrices (m > n) over the flattened mesh.
+
+    Returns ``(LU, perm, info)`` with ``A[perm] = L @ U`` in O(m n²/P) work —
+    the mesh form of the reference's tall ``getrf.cc`` regime, replacing
+    round 2's O(m³) square embedding.  Rows are padded to P·nb blocks and
+    columns to nb multiples; pad columns carry unit pivots on pad rows so
+    they never disturb the real factorization.
+    """
+    m, n = A.shape[-2:]
+    slate_assert(m >= n, "getrf_tall_distributed expects m >= n")
+    nb = max(1, min(nb, n))
+    unit = nb * grid.p * grid.q
+    npc = ceil_mult(n, nb)
+    mpad = ceil_mult(m, unit)
+    if mpad - m < npc - n:      # need a pad row per pad column
+        mpad += unit
+    if (mpad, npc) != (m, n):
+        Ap = jnp.zeros((mpad, npc), A.dtype)
+        Ap = Ap.at[:m, :n].set(A)
+        if npc > n:             # unit pivots for pad columns, on pad rows
+            Ap = Ap.at[m + jnp.arange(npc - n), n + jnp.arange(npc - n)].set(1)
+    else:
+        Ap = A
+    mesh = grid.mesh
+    Ap = jax.device_put(Ap, jax.sharding.NamedSharding(
+        mesh, P((ROW_AXIS, COL_AXIS), None)))
+    LU, perm, info = _getrf_tall_fn(mesh, mpad, npc, nb, str(Ap.dtype))(Ap)
+    if mpad > m:
+        # pad columns carry their unit pivot on a PAD row, so each pad column
+        # deterministically swaps one pad row into the head — positions
+        # [n, npc) of the head hold pad rows and their displaced real rows sit
+        # in the tail.  (Unlike the square embedding, this is the *generic*
+        # case, not a singularity signal.)  Repair both halves of the
+        # truncation: the perm entry AND the L row, gathered from the padded
+        # position where the displaced real row actually resides — valid
+        # because row r of P·A_pad satisfies A[r] = L_pad[pos(r), :n] @ U for
+        # every real row wherever it sits.
+        head = perm[:m]
+        bad = head >= m
+        tail = perm[m:]
+        key = jnp.where(tail < m, tail, mpad)
+        order = jnp.argsort(key)             # tail slots sorted by row value
+        cum = jnp.cumsum(bad) - 1            # index among bad slots
+        repl = jnp.sort(key)[jnp.clip(cum, 0, key.shape[0] - 1)]
+        srcpos = (m + order)[jnp.clip(cum, 0, order.shape[0] - 1)]
+        perm = jnp.where(bad, repl, head)
+        LUm = jnp.where(bad[:, None],
+                        LU[jnp.clip(srcpos, 0, mpad - 1)], LU[:m])
+        # a pad row inside the first n positions means a REAL column went
+        # singular (its zero U diagonal already set info <= n); pad-column
+        # info (> n) is the benign embedding diagonal
+        info = jnp.where(info > n, jnp.int32(0), info)
+        return LUm[:, :n], perm, info
+    perm = perm[:m]
+    info = jnp.where(info > n, jnp.int32(0), info)
+    return LU[:m, :n], perm, info
+
+
 def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """Distributed tournament-pivoted LU over the process grid.
 
@@ -219,13 +425,10 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     upper, packed into one sharded array) — the distributed form of
     ``linalg.lu.getrf_tntpiv`` and the analogue of ``src/getrf_tntpiv.cc``.
 
-    Tall inputs (m > n) embed into one npad-square problem (appended unit
-    columns + the usual identity tail): pivot selection in the first n panels
-    never sees the appended columns (they are zero in every real column), so
-    ``LU[:, :n]`` and the length-m ``perm`` are exactly the tall
-    factorization.  The embedding costs O(m^3) instead of O(m n^2), so
-    *callers* should route very tall panels elsewhere (the driver dispatch
-    guards at m <= 2n).
+    Tall inputs (m > n) route to ``getrf_tall_distributed`` — 1-D TSLU over
+    the flattened mesh with O(m n²/P) work (round 2's O(m³) square embedding
+    is gone; the reference's getrf.cc handles the same regime on its 2-D
+    grid, but with columns local the tall panel needs no broadcast at all).
 
     Wide inputs (m < n) factor the leading m×m block — partial pivoting never
     looks past column m — and finish the trailing columns with one sharded
@@ -234,6 +437,8 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """
     m, n = A.shape[-2:]
     slate_assert(A.ndim == 2, "getrf_distributed expects a 2-D matrix")
+    if m > n:
+        return getrf_tall_distributed(A, grid, nb=nb)
     if m < n:
         from .solvers import trsm_distributed
 
