@@ -29,4 +29,13 @@ from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       geqrf_distributed, gels_caqr_distributed)
 from .eig_dist import (heev_distributed, hegv_distributed, svd_distributed,
                        norm_distributed, col_norms_distributed)
+from .inverse import (trtri_distributed, trtrm_distributed, potri_distributed,
+                      getri_distributed)
+from .band_dist import (pbtrf_distributed, pbtrs_distributed, pbsv_distributed,
+                        tbsm_distributed, gbtrf_distributed, gbtrs_distributed,
+                        gbsv_distributed, dense_to_band_lower,
+                        band_lower_to_dense, dense_to_band_general,
+                        band_general_to_dense)
+from .indefinite_dist import (hetrf_distributed, hetrs_distributed,
+                              hesv_distributed, HermitianFactorsDist)
 from .pipeline import potrf_pipelined

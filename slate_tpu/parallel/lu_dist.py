@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from .pivot import step_permutation, tournament_piv
 
 
 @lru_cache(maxsize=32)
@@ -79,40 +80,10 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             k0 = (k * nb).astype(jnp.int32) if hasattr(k, "astype") else k * nb
             pan = extract_panel(A_loc, k0)
 
-            # ---- tournament round 1: local candidates (internal_getrf_tntpiv)
-            cand_ok = grow >= k0
-            panm = jnp.where(cand_ok[:, None], pan, jnp.zeros_like(pan))
-            _, _, perm_loc = lax.linalg.lu(panm)
-            sel = perm_loc[:nb]
-            cand_rows = pan[sel]                       # original values, not LU'd
-            cand_idx = grow[sel]
-            cand_idx = jnp.where(cand_ok[sel], cand_idx, jnp.int32(-1))
-            cand_rows = jnp.where((cand_idx >= 0)[:, None], cand_rows,
-                                  jnp.zeros_like(cand_rows))
-
-            # ---- tournament round 2: stacked LU over gathered candidates
-            # (the reference's binary reduction tree in one ICI round)
-            C = lax.all_gather(cand_rows, ROW_AXIS).reshape(p * nb, nb)
-            I = lax.all_gather(cand_idx, ROW_AXIS).reshape(p * nb)
-            _, _, pfin = lax.linalg.lu(C)
-            piv = I[pfin[:nb]]
-            # degenerate slots (singular trailing block): identity swap
-            piv = jnp.where(piv >= k0, piv,
-                            k0 + jnp.arange(nb, dtype=jnp.int32))
-
-            # ---- build the step permutation (sequential-swap semantics,
-            # LAPACK ipiv-compatible; permuteRows analogue)
-            def swap_body(i, sp_spos):
-                sp, spos = sp_spos
-                a = k0 + i
-                b = spos[piv[i]]
-                ra, rb = sp[a], sp[b]
-                sp = sp.at[a].set(rb).at[b].set(ra)
-                spos = spos.at[rb].set(a).at[ra].set(b)
-                return sp, spos
-
-            iota = jnp.arange(npad, dtype=jnp.int32)
-            stepperm, _ = lax.fori_loop(0, nb, swap_body, (iota, iota))
+            # ---- tournament rounds + ipiv-compatible step permutation
+            # (shared machinery, pivot.py; internal_getrf_tntpiv analogue)
+            piv = tournament_piv(pan, grow, k0, nb, p, ROW_AXIS)
+            stepperm = step_permutation(piv, k0, npad, nb)
             perm = perm[stepperm]
 
             # ---- apply the row permutation: only dirty rows move.
@@ -241,37 +212,11 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
             A_loc, perm = carry
             k0 = (k * nb).astype(jnp.int32) if hasattr(k, "astype") else k * nb
 
-            # ---- tournament round 1: local candidates over my rows
+            # ---- tournament rounds + ipiv-compatible step permutation
+            # (shared machinery, pivot.py)
             pan = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
-            cand_ok = grow >= k0
-            panm = jnp.where(cand_ok[:, None], pan, jnp.zeros_like(pan))
-            _, _, perm_loc = lax.linalg.lu(panm)
-            sel = perm_loc[:nb]
-            cand_rows = pan[sel]
-            cand_idx = jnp.where(cand_ok[sel], grow[sel], jnp.int32(-1))
-            cand_rows = jnp.where((cand_idx >= 0)[:, None], cand_rows,
-                                  jnp.zeros_like(cand_rows))
-
-            # ---- round 2: stacked LU over the gathered candidates
-            C = lax.all_gather(cand_rows, AX).reshape(nprocs * nb, nb)
-            I = lax.all_gather(cand_idx, AX).reshape(nprocs * nb)
-            _, _, pfin = lax.linalg.lu(C)
-            piv = I[pfin[:nb]]
-            piv = jnp.where(piv >= k0, piv,
-                            k0 + jnp.arange(nb, dtype=jnp.int32))
-
-            # ---- sequential-swap step permutation (ipiv-compatible)
-            def swap_body(i, sp_spos):
-                sp, spos = sp_spos
-                a = k0 + i
-                b = spos[piv[i]]
-                ra, rb = sp[a], sp[b]
-                sp = sp.at[a].set(rb).at[b].set(ra)
-                spos = spos.at[rb].set(a).at[ra].set(b)
-                return sp, spos
-
-            iota = jnp.arange(mpad, dtype=jnp.int32)
-            stepperm, _ = lax.fori_loop(0, nb, swap_body, (iota, iota))
+            piv = tournament_piv(pan, grow, k0, nb, nprocs, AX)
+            stepperm = step_permutation(piv, k0, mpad, nb)
             perm = perm[stepperm]
 
             # ---- dirty-row exchange (≤ 2nb rows move, full local width)

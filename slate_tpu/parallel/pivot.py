@@ -1,0 +1,59 @@
+"""Shared tournament-pivoting machinery for the distributed factorizations.
+
+One implementation of the CALU candidate rounds (internal_getrf_tntpiv.cc
+semantics: block-local partially-pivoted LUs, then one stacked LU over the
+gathered winners) and of the LAPACK-ipiv-compatible sequential-swap step
+permutation, used by the square tournament LU (``_getrf_dist_fn``), the tall
+TSLU (``_getrf_tall_fn``), and the Aasen panel (``_hetrf_dist_fn``) — a
+single source of truth so a pivoting fix cannot drift between the three.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def tournament_piv(W, grow, k0, nb: int, nprocs: int, ax):
+    """Two-round tournament over the flattened/row mesh axis ``ax``.
+
+    ``W``: my rows of the panel (mr, nb); ``grow``: global row index per local
+    row; ``k0``: first eligible global row.  Returns the nb winning global
+    rows in pivot order, with degenerate slots (singular trailing block)
+    falling back to the identity ``k0 + i``.
+    """
+    cand_ok = grow >= k0
+    Wm = jnp.where(cand_ok[:, None], W, jnp.zeros_like(W))
+    _, _, perm_loc = lax.linalg.lu(Wm)
+    sel = perm_loc[:nb]
+    cand_rows = W[sel]                       # original values, not LU'd
+    cand_idx = jnp.where(cand_ok[sel], grow[sel], jnp.int32(-1))
+    cand_rows = jnp.where((cand_idx >= 0)[:, None], cand_rows,
+                          jnp.zeros_like(cand_rows))
+    C = lax.all_gather(cand_rows, ax).reshape(nprocs * nb, nb)
+    I = lax.all_gather(cand_idx, ax).reshape(nprocs * nb)
+    _, _, pfin = lax.linalg.lu(C)
+    piv = I[pfin[:nb]]
+    return jnp.where(piv >= k0, piv, k0 + jnp.arange(nb, dtype=jnp.int32))
+
+
+def step_permutation(piv, k0, npad: int, nb: int):
+    """Replay the nb sequential interchanges ``position k0+i <-> row piv[i]``
+    into a length-npad permutation (new position -> old position) — the
+    LAPACK-ipiv-compatible form every distributed factorization composes
+    into its global ``perm``.  Out-of-range positions (k0 + i >= npad, only
+    reachable on a guarded final panel) drop harmlessly.
+    """
+
+    def swap_body(i, sp_spos):
+        sp, spos = sp_spos
+        a = k0 + i
+        b = spos[jnp.clip(piv[i], 0, npad - 1)]
+        ra, rb = sp[jnp.clip(a, 0, npad - 1)], sp[b]
+        sp = sp.at[a].set(rb, mode="drop").at[b].set(ra, mode="drop")
+        spos = spos.at[rb].set(a, mode="drop").at[ra].set(b, mode="drop")
+        return sp, spos
+
+    iota = jnp.arange(npad, dtype=jnp.int32)
+    stepperm, _ = lax.fori_loop(0, nb, swap_body, (iota, iota))
+    return stepperm
