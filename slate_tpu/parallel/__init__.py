@@ -26,7 +26,9 @@ from .lu_dist import (getrf_distributed, getrf_tall_distributed,
                       getrs_distributed, gesv_distributed,
                       gesv_mixed_distributed, gesv_mixed_gmres_distributed)
 from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
-                      geqrf_distributed, gels_caqr_distributed)
+                      geqrf_distributed, gels_caqr_distributed,
+                      gelqf_distributed, unmlq_distributed,
+                      gels_lq_distributed)
 from .eig_dist import (heev_distributed, hegv_distributed, svd_distributed,
                        norm_distributed, col_norms_distributed)
 from .inverse import (trtri_distributed, trtrm_distributed, potri_distributed,

@@ -487,7 +487,6 @@ def _gbtrs_fwd_dist_fn(mesh, npad: int, kl: int, ku: int, nb: int, nrhs: int,
     klt = max(1, _ceil_div(kl, nb))
     wr = (klt + 1) * nb
     fill = ku + kl
-    nd = wr + kl + ku                   # factored-form depth (see _gbtrf_dist_fn)
     nt = npad // nb
 
     def local_fn(Gb_loc, perms, B_loc):
@@ -525,10 +524,8 @@ def _gbtrs_bwd_dist_fn(mesh, npad: int, kl: int, ku: int, nb: int, nrhs: int,
     nc = npad // nprocs
     klt = max(1, _ceil_div(kl, nb))
     kut = max(1, _ceil_div(ku, nb))
-    wr = (klt + 1) * nb
     wc = (klt + kut + 1) * nb
     fill = ku + kl
-    nd = wr + kl + ku                   # factored-form depth (see _gbtrf_dist_fn)
     nt = npad // nb
 
     def local_fn(Gb_loc, B_loc):

@@ -177,14 +177,7 @@ def _hetrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             lmask = (gcol >= nb) & (gcol < j1)
             L_loc = jnp.where(lmask[None, :], Lsw, L_loc)
             # W rows follow the same permutation
-            Wsw_rows = W[jnp.clip(src - ri * mr, 0, mr - 1)]
-            own_w = ((src - ri * mr) >= 0) & ((src - ri * mr) < mr)
-            Wsw_rows = jnp.where(own_w[:, None], Wsw_rows,
-                                 jnp.zeros_like(Wsw_rows))
-            Wsw_rows = lax.psum(Wsw_rows, AX)
-            dstw = S - ri * mr
-            dstw = jnp.where((dstw >= 0) & (dstw < mr), dstw, mr)
-            W = W.at[dstw].set(Wsw_rows, mode="drop")
+            W = exchange_rows(W)
 
             # ---- factor the swapped panel block
             blk = extract_rows(W, j1, nb)

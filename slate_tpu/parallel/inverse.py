@@ -23,7 +23,7 @@ from jax import lax
 
 from .mesh import ProcessGrid
 from .solvers import trsm_distributed
-from .summa import gemm_distributed
+from .summa import gemm_padded
 
 
 def trtri_distributed(T: jax.Array, grid: ProcessGrid, lower: bool = True,
@@ -46,10 +46,10 @@ def trtrm_distributed(T: jax.Array, grid: ProcessGrid,
     second half of potri (src/trtrm.cc), as one SUMMA gemm over the grid."""
     if lower:
         L = jnp.tril(T)
-        out = gemm_distributed(jnp.conj(L.T), L, grid)
+        out = gemm_padded(jnp.conj(L.T), L, grid)
         return jnp.tril(out)
     U = jnp.triu(T)
-    out = gemm_distributed(U, jnp.conj(U.T), grid)
+    out = gemm_padded(U, jnp.conj(U.T), grid)
     return jnp.triu(out)
 
 

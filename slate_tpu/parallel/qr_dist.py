@@ -234,3 +234,42 @@ def gels_caqr_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     Q, R = geqrf_distributed(A, grid, nb=nb)
     QhB = jnp.matmul(jnp.conj(Q.T), B, precision=lax.Precision.HIGHEST)
     return lax.linalg.triangular_solve(R, QhB, left_side=True, lower=False)
+
+
+def gelqf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
+    """Distributed LQ factorization A = L Q over the mesh (src/gelqf.cc).
+
+    Like the single-device ``linalg.qr.gelqf``, LQ is CAQR of A^H: A^H = Q1 R1
+    gives A = R1^H Q1^H — the transpose is one resharding device_put, and the
+    factorization itself is the 2-D BCGS2+TSQR pipeline (``geqrf_distributed``)
+    the reference's gelqf.cc mirrors with its own ttlqt trees.  Returns
+    ``(L, Q)``: L (m×m lower, for m ≤ n), Q (m×n with orthonormal rows).
+    """
+    m, n = A.shape[-2:]
+    slate_assert(n >= m, "gelqf_distributed expects a wide matrix (m <= n)")
+    Q1, R1 = geqrf_distributed(jnp.conj(A.T), grid, nb=nb)
+    return jnp.conj(R1.T), jnp.conj(Q1.T)
+
+
+def unmlq_distributed(Q: jax.Array, C: jax.Array, grid: ProcessGrid,
+                      conj_trans: bool = False) -> jax.Array:
+    """Apply the LQ factor's Q (rows orthonormal) to C from the left over the
+    mesh (src/unmlq.cc): op(Q) @ C as one SUMMA gemm — with Q explicit, the
+    compact-WY replay the reference schedules collapses into the sharded
+    product."""
+    from .summa import gemm_padded
+
+    Qop = jnp.conj(Q.T) if conj_trans else Q
+    return gemm_padded(Qop, C, grid)
+
+
+def gels_lq_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
+                        nb: int = 256) -> jax.Array:
+    """Minimum-norm solution of the underdetermined system A X = B over the
+    mesh (src/gels.cc wide branch): A = L Q, X = Q^H L^{-1} B — sharded
+    triangular solve + SUMMA back-multiply."""
+    from .solvers import trsm_distributed
+
+    L, Q = gelqf_distributed(A, grid, nb=nb)
+    Y = trsm_distributed(L, B, grid, lower=True, conj_trans=False)
+    return unmlq_distributed(Q, Y, grid, conj_trans=True)

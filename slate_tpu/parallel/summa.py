@@ -156,3 +156,24 @@ def gemm_distributed(A, B, grid: ProcessGrid, method: str = "auto",
     if method == "ring":
         return gemm_ring(A, B, grid, precision)
     return gemm_allgather(A, B, grid, precision)
+
+
+def gemm_padded(A: jax.Array, B: jax.Array, grid: ProcessGrid,
+                precision=lax.Precision.HIGHEST) -> jax.Array:
+    """``gemm_distributed`` for arbitrary shapes: zero-pads both operands to
+    grid-tile multiples (the pad-and-mask edge policy, SURVEY §7 hard-part 5),
+    runs the sharded product, slices the result — the convenience form every
+    composition layer (inversion, LQ, ScaLAPACK skin) should call instead of
+    hand-padding."""
+    from ..core.exceptions import slate_assert
+    from .distribute import lcm, pad2d
+
+    m, k = A.shape[-2:]
+    n = B.shape[-1]
+    slate_assert(k == B.shape[-2],
+                 f"gemm inner dims {k} != {B.shape[-2]} (padding would mask it)")
+    mult = lcm(grid.p, grid.q)
+    Ap = pad2d(A, grid.p, mult)
+    Bp = pad2d(B, mult, grid.q)
+    C = gemm_distributed(Ap, Bp, grid, precision=precision)
+    return C[..., :m, :n] if C.shape[-2:] != (m, n) else C

@@ -106,6 +106,7 @@ class TestBandLUDist:
         X, info = gbsv_distributed(Gb, jnp.asarray(B), grid24, kl, ku, nb=8)
         assert np.linalg.norm(G @ np.asarray(X) - B) / np.linalg.norm(B) \
             < 1e-11
+        assert int(info) == 0
 
     def test_gbtrf_factor_reuse(self, grid24, rng):
         n, kl, ku = 96, 4, 6
@@ -186,3 +187,38 @@ class TestInverseDist:
         ref = np.linalg.inv(g)
         assert np.linalg.norm(Ginv - ref) / np.linalg.norm(ref) < 1e-10
         assert int(info) == 0
+
+
+class TestLQDist:
+    """Distributed LQ family (src/gelqf.cc, src/unmlq.cc, gels wide branch)."""
+
+    def test_gelqf_reconstruction(self, grid24, rng):
+        from slate_tpu.parallel import gelqf_distributed
+        m, n = 60, 180
+        a = rng.standard_normal((m, n))
+        L, Q = gelqf_distributed(jnp.asarray(a), grid24, nb=16)
+        L, Q = np.asarray(L), np.asarray(Q)
+        assert np.linalg.norm(L @ Q - a) / np.linalg.norm(a) < 1e-13
+        assert np.linalg.norm(Q @ Q.T - np.eye(m)) < 1e-12
+        assert np.linalg.norm(np.triu(L, 1)) == 0.0
+
+    def test_gels_lq_min_norm(self, grid24, rng):
+        from slate_tpu.parallel import gels_lq_distributed
+        m, n = 50, 140          # unaligned wide shape
+        a = rng.standard_normal((m, n))
+        B = rng.standard_normal((m, 3))
+        X = np.asarray(gels_lq_distributed(jnp.asarray(a), jnp.asarray(B),
+                                           grid24, nb=16))
+        ref = np.linalg.lstsq(a, B, rcond=None)[0]
+        assert np.linalg.norm(X - ref) / np.linalg.norm(ref) < 1e-12
+
+    def test_potri_unaligned(self, grid24, rng):
+        """gemm_padded lets the inversion compositions take any n."""
+        n = 90
+        g = rng.standard_normal((n, n))
+        spd = g @ g.T + n * np.eye(n)
+        L = potrf_distributed(jnp.asarray(spd), grid24, nb=16)
+        Ainv = np.asarray(potri_distributed(L, grid24))
+        full = np.tril(Ainv) + np.tril(Ainv, -1).T
+        ref = np.linalg.inv(spd)
+        assert np.linalg.norm(full - ref) / np.linalg.norm(ref) < 1e-11
