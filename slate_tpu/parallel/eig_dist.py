@@ -9,10 +9,11 @@ sterf/steqr/stedc -> redistribute -> back-transforms), ``src/svd.cc:99-141``
 TPU re-design:
 
 * **Stage 1 is where the flops are** (O(n^2 nb) gemms per panel, O(n^3)
-  total) — it runs *sharded*: the blocked he2hb / ge2tb_band loops are jitted
-  with the operand placed on the (p, q) mesh and GSPMD partitions the
-  two-sided block-reflector gemms, inserting the panel all-gathers the
-  reference does with listBcast (SURVEY.md §5.8 mapping).
+  total) — it runs as an *explicit shard_map* pipeline (round-3 rewrite:
+  the round-2 GSPMD form compiled sharded but replicated the loop state —
+  see ``_he2hb_shard_fn``): 1-D block rows, one panel all-gather + one
+  W-psum per step, the reference's listBcast collapsed into the mesh
+  collectives (SURVEY.md §5.8 mapping).
 * **Stage 2 is sequential by nature** (bulge chasing) and cheap (O(n^2 kd));
   the band is *replicated* across the mesh — the exact analogue of
   ``he2hbGather`` pulling the band to rank 0 (heev.cc:133-135) — and chased
@@ -50,30 +51,258 @@ def _shard(x, grid: ProcessGrid, row: bool = True, col: bool = True):
     return _constrain_fn(grid.mesh, row, col)(x)
 
 
+AX = (ROW_AXIS, COL_AXIS)                  # flattened device axis
+
+
 @lru_cache(maxsize=32)
-def _he2hb_dist_fn(mesh, n: int, nb: int, dtype_str: str):
-    from ..linalg.eig import he2hb
+def _he2hb_shard_fn(mesh, npad: int, nb: int, dtype_str: str):
+    """Explicit shard_map he2hb over the flattened mesh (src/he2hb.cc, 729
+    LoC of grid QR panels + ttqrt trees + two-sided updates).
 
-    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+    Round-2 review finding: the old GSPMD form (`with_sharding_constraint` +
+    jit around the sequential fori_loop) compiled with sharded operands but
+    ran 7x *slower* on a 2x4 mesh than one device — the partitioner inserted
+    per-panel resharding instead of the algorithm's natural collectives.
+    This version owns the layout: 1-D block rows (columns local), the panel
+    gathered once per step (O(n·nb) bytes), the replicated O(n·nb²) panel QR
+    recomputed on every device (far cheaper than shipping factors), and the
+    two-sided O(n²·nb) block-reflector gemms fully local except ONE psum for
+    W = V^H A.  Two collectives per panel, total O(n²) bytes.
+    """
+    from ..linalg import householder as hh
 
-    def fn(Af):
-        Af = lax.with_sharding_constraint(Af, spec)
-        return he2hb(Af, nb=nb)
+    nprocs = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    mr = npad // nprocs
+    nt = npad // nb
+    nj = max(nt - 1, 0)
+    prec = lax.Precision.HIGHEST
 
+    def local_fn(A_loc):                   # (mr, npad)
+        ri = lax.axis_index(AX)
+        r0 = (ri * mr).astype(jnp.int32)
+        grow = r0 + jnp.arange(mr, dtype=jnp.int32)
+        gcol = jnp.arange(npad, dtype=jnp.int32)
+
+        def body(j, carry):
+            A_loc, Vs_loc, Ts = carry
+            k0 = (j * nb).astype(jnp.int32) if hasattr(j, "astype") else j * nb
+            off = k0 + nb
+            P_loc = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
+            P_full = lax.all_gather(P_loc, AX).reshape(npad, nb)
+            _, V, taus = hh.panel_qr_masked(P_full, off, nb)
+            T = hh.build_T(V, taus)
+            V_loc = lax.dynamic_slice(V, (r0, jnp.int32(0)), (mr, nb))
+            # left apply Q^H A: W = V^H A rides the mesh's one psum
+            W = lax.psum(jnp.matmul(jnp.conj(V_loc).T, A_loc, precision=prec),
+                         AX)                                     # (nb, npad)
+            A_loc = A_loc - jnp.matmul(
+                V_loc, jnp.matmul(jnp.conj(T).T, W, precision=prec),
+                precision=prec)
+            # right apply (Q^H A) Q: V replicated => fully local gemms
+            Y = jnp.matmul(A_loc, V, precision=prec)             # (mr, nb)
+            A_loc = A_loc - jnp.matmul(jnp.matmul(Y, T, precision=prec),
+                                       jnp.conj(V).T, precision=prec)
+            Vs_loc = lax.dynamic_update_slice(Vs_loc, V_loc[None], (j, 0, 0))
+            Ts = lax.dynamic_update_slice(Ts, T[None], (j, 0, 0))
+            return A_loc, Vs_loc, Ts
+
+        Vs0 = jnp.zeros((max(nj, 1), mr, nb), A_loc.dtype)
+        Ts0 = jnp.zeros((max(nj, 1), nb, nb), A_loc.dtype)
+        A_loc, Vs_loc, Ts = lax.fori_loop(0, nj, body, (A_loc, Vs0, Ts0))
+        band_loc = jnp.where(
+            jnp.abs(grow[:, None] - gcol[None, :]) <= nb, A_loc,
+            jnp.zeros_like(A_loc))
+        return band_loc, Vs_loc, Ts
+
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=P(AX, None),
+                       out_specs=(P(AX, None), P(None, AX, None), P(None)),
+                       check_vma=False)
     return jax.jit(fn)
 
 
 @lru_cache(maxsize=32)
-def _ge2tb_dist_fn(mesh, m: int, n: int, nb: int, dtype_str: str):
-    from ..linalg.svd import ge2tb_band
+def _unmtr_he2hb_shard_fn(mesh, npad: int, ncols: int, nb: int, nj: int,
+                          descending: bool, conj_q: bool, dtype_str: str):
+    """Left-side stage-1 back-transform on the sharded reflector stack
+    (src/unmtr_he2hb.cc): per block one psum for W = V^H C, the rest local."""
+    nprocs = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    mr = npad // nprocs
+    prec = lax.Precision.HIGHEST
 
-    spec = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+    def local_fn(Vs_loc, Ts, C_loc):       # (nj, mr, nb), (nj, nb, nb), (mr, ncols)
+        def body(jj, C_loc):
+            j = nj - 1 - jj if descending else jj
+            V_loc = lax.dynamic_index_in_dim(Vs_loc, j, 0, keepdims=False)
+            T = lax.dynamic_index_in_dim(Ts, j, 0, keepdims=False)
+            Tm = jnp.conj(T).T if conj_q else T
+            W = lax.psum(jnp.matmul(jnp.conj(V_loc).T, C_loc, precision=prec),
+                         AX)
+            return C_loc - jnp.matmul(V_loc,
+                                      jnp.matmul(Tm, W, precision=prec),
+                                      precision=prec)
 
-    def fn(Af):
-        Af = lax.with_sharding_constraint(Af, spec)
-        return ge2tb_band(Af, nb=nb)
+        return lax.fori_loop(0, nj, body, C_loc)
 
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(None, AX, None), P(None), P(AX, None)),
+                       out_specs=P(AX, None), check_vma=False)
     return jax.jit(fn)
+
+
+def he2hb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
+    """Distributed stage-1 band reduction A = Q band Q^H over the flattened
+    mesh.  Returns ``(band, Vs, Ts)``: band (n, n) bandwidth-nb, Vs sharded
+    (nj, n, nb) reflector rows, Ts (nj, nb, nb) replicated."""
+    from .distribute import ceil_mult
+
+    n = A.shape[-1]
+    nprocs = grid.p * grid.q
+    npad = ceil_mult(n, nb * nprocs)
+    if npad > n:
+        Ap = jnp.zeros((npad, npad), A.dtype)
+        Ap = Ap.at[:n, :n].set(A)
+        idx = jnp.arange(n, npad)
+        Ap = Ap.at[idx, idx].set(1)
+    else:
+        Ap = A
+    Ap = jax.device_put(Ap, NamedSharding(grid.mesh, P(AX, None)))
+    band, Vs, Ts = _he2hb_shard_fn(grid.mesh, npad, nb, str(Ap.dtype))(Ap)
+    return band[:n, :n], Vs, Ts
+
+
+def unmtr_he2hb_distributed(Vs: jax.Array, Ts: jax.Array, C: jax.Array,
+                            grid: ProcessGrid, conj_q: bool = False):
+    """Apply the stage-1 Q (NoTrans, left) from the sharded reflector stack to
+    a row-sharded C: Q C = H_0 ... H_{nj-1} C applied descending (conj_q
+    flips to ascending Q^H C)."""
+    nj, npad, nb = Vs.shape
+    n, ncols = C.shape[-2:]
+    if npad > n:
+        Cp = jnp.zeros((npad, ncols), C.dtype).at[:n].set(C)
+    else:
+        Cp = C
+    Cp = jax.device_put(Cp, NamedSharding(grid.mesh, P(AX, None)))
+    out = _unmtr_he2hb_shard_fn(grid.mesh, npad, ncols, nb, nj,
+                                not conj_q, conj_q, str(Cp.dtype))(Vs, Ts, Cp)
+    return out[:n]
+
+
+@lru_cache(maxsize=32)
+def _ge2tb_shard_fn(mesh, mpad: int, npc: int, nreal: int, nb: int,
+                    dtype_str: str):
+    """Explicit shard_map ge2tb band reduction (src/ge2tb.cc): alternating
+    QR column panels (left apply — one all-gather + one psum, like
+    ``_he2hb_shard_fn``) and LQ row panels, whose right applies are FULLY
+    local in the 1-D row layout (columns resident; only the nb-row panel
+    extraction psums).  Three O(n·nb)-byte collectives per panel."""
+    from ..linalg import householder as hh
+
+    nprocs = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    mr = mpad // nprocs
+    ncv = npc // nprocs                    # Vv rows live sharded too
+    nt = max(-(-nreal // nb), 1)
+    prec = lax.Precision.HIGHEST
+
+    def local_fn(A_loc):                   # (mr, npc)
+        ri = lax.axis_index(AX)
+        r0 = (ri * mr).astype(jnp.int32)
+        grow = r0 + jnp.arange(mr, dtype=jnp.int32)
+
+        def body(j, carry):
+            A_loc, Vu_loc, Tu, Vv, Tv = carry
+            k0 = (j * nb).astype(jnp.int32) if hasattr(j, "astype") else j * nb
+            # --- QR column panel (pivots on the diagonal)
+            P_loc = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
+            P_full = lax.all_gather(P_loc, AX).reshape(mpad, nb)
+            _, V, taus = hh.panel_qr_masked(P_full, k0, nb)
+            T = hh.build_T(V, taus)
+            V_loc = lax.dynamic_slice(V, (r0, jnp.int32(0)), (mr, nb))
+            W = lax.psum(jnp.matmul(jnp.conj(V_loc).T, A_loc, precision=prec),
+                         AX)                                     # (nb, npc)
+            A_loc = A_loc - jnp.matmul(
+                V_loc, jnp.matmul(jnp.conj(T).T, W, precision=prec),
+                precision=prec)
+            Vu_loc = lax.dynamic_update_slice(Vu_loc, V_loc[None], (j, 0, 0))
+            Tu = lax.dynamic_update_slice(Tu, T[None], (j, 0, 0))
+            # --- LQ row panel (pivots one block right): extract nb rows
+            S = k0 + jnp.arange(nb, dtype=jnp.int32)
+            loc = S - r0
+            own = (loc >= 0) & (loc < mr)
+            Prow = A_loc[jnp.clip(loc, 0, mr - 1)]
+            Prow = jnp.where(own[:, None], Prow, jnp.zeros_like(Prow))
+            Prow = lax.psum(Prow, AX)                            # (nb, npc)
+            _, Vr, tausr = hh.panel_lq_masked(Prow, k0 + nb, nb)
+            Tr = hh.build_T(Vr, tausr)
+            # right apply: columns are local => zero collectives
+            Y = jnp.matmul(A_loc, Vr, precision=prec)            # (mr, nb)
+            A_loc = A_loc - jnp.matmul(jnp.matmul(Y, Tr, precision=prec),
+                                       jnp.conj(Vr).T, precision=prec)
+            Vr_loc = lax.dynamic_slice(Vr, ((ri * ncv).astype(jnp.int32),
+                                            jnp.int32(0)), (ncv, nb))
+            Vv = lax.dynamic_update_slice(Vv, Vr_loc[None], (j, 0, 0))
+            Tv = lax.dynamic_update_slice(Tv, Tr[None], (j, 0, 0))
+            return A_loc, Vu_loc, Tu, Vv, Tv
+
+        Vu0 = jnp.zeros((nt, mr, nb), A_loc.dtype)
+        Tu0 = jnp.zeros((nt, nb, nb), A_loc.dtype)
+        Vv0 = jnp.zeros((nt, ncv, nb), A_loc.dtype)
+        Tv0 = jnp.zeros((nt, nb, nb), A_loc.dtype)
+        A_loc, Vu_loc, Tu, Vv, Tv = lax.fori_loop(
+            0, nt, body, (A_loc, Vu0, Tu0, Vv0, Tv0))
+        gcol = jnp.arange(npc, dtype=jnp.int32)
+        band_loc = jnp.where(
+            (gcol[None, :] >= grow[:, None])
+            & (gcol[None, :] - grow[:, None] <= nb), A_loc,
+            jnp.zeros_like(A_loc))
+        return band_loc, Vu_loc, Tu, Vv, Tv
+
+    fn = jax.shard_map(
+        local_fn, mesh=mesh, in_specs=P(AX, None),
+        out_specs=(P(AX, None), P(None, AX, None), P(None),
+                   P(None, AX, None), P(None)),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def _apply_stacked_left(Vs: jax.Array, Ts: jax.Array, C: jax.Array,
+                        grid: ProcessGrid, conj_q: bool = False):
+    """Left-apply a stacked block-reflector factor through the sharded unmtr
+    sweep regardless of how Vs arrived (sharded from he2hb/ge2tb, or
+    replicated like the right-side Vv): rows pad to a mesh-divisible count
+    (zero reflector rows act as identity) and reshard in one device_put."""
+    from .distribute import ceil_mult
+
+    nj, nv, nb = Vs.shape
+    nprocs = grid.p * grid.q
+    nvp = ceil_mult(nv, nprocs)
+    if nvp > nv:
+        Vs = jnp.concatenate(
+            [Vs, jnp.zeros((nj, nvp - nv, nb), Vs.dtype)], axis=1)
+    Vs = jax.device_put(Vs, NamedSharding(grid.mesh, P(None, AX, None)))
+    return unmtr_he2hb_distributed(Vs, Ts, C, grid, conj_q=conj_q)
+
+
+def ge2tb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
+    """Distributed stage-1 general->band reduction A = U band V^H over the
+    flattened mesh.  Returns ``(band, (Vu, Tu), (Vv, Tv))``: band (m, n)
+    upper-bandwidth nb, Vu sharded reflector rows, Vv replicated (applied
+    from the right — columns are local in this layout)."""
+    from ..core.exceptions import slate_assert
+    from .distribute import ceil_mult
+
+    m, n = A.shape[-2:]
+    slate_assert(m >= n, "ge2tb_distributed requires m >= n")
+    nprocs = grid.p * grid.q
+    mpad = ceil_mult(m + nb, nb * nprocs)
+    # pad so the last panel never clamps AND Vv rows shard evenly; reflector
+    # entries on pad columns are exactly zero (the padded A columns are), so
+    # keeping the full npc rows loses nothing and stays sharded
+    npc = ceil_mult(n + nb, nprocs)
+    Ap = jnp.zeros((mpad, npc), A.dtype).at[:m, :n].set(A)
+    Ap = jax.device_put(Ap, NamedSharding(grid.mesh, P(AX, None)))
+    band, Vu, Tu, Vv, Tv = _ge2tb_shard_fn(grid.mesh, mpad, npc, n, nb,
+                                           str(Ap.dtype))(Ap)
+    return band[:m, :n], (Vu, Tu), (Vv, Tv)
 
 
 def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
@@ -84,7 +313,7 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     Returns (ascending eigenvalues, Z or None); Z comes back sharded on the
     grid.  ``method_eig='dc'`` solves the tridiagonal with stedc.
     """
-    from ..linalg.eig import _safe_scale, hb2st, sterf, unmtr_he2hb
+    from ..linalg.eig import _safe_scale, hb2st, sterf
     from ..linalg.stedc import stedc as _stedc
     from ..linalg.eig import steqr
 
@@ -96,10 +325,15 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                   else (jnp.linalg.eigvalsh(A), None))
         return lam, z
     nb = max(2, min(nb, max(2, n // 2)))
+    # clamp against the nb·nprocs padding granularity: pad stays ≤ ~n/4, so
+    # the O(n²·nb) stage-1 gemms never run on a matrix 2× the real linear
+    # size for unaligned n (the chase below uses the same clamped kd)
+    nprocs = grid.p * grid.q
+    if n >= 8 * nprocs:
+        nb = max(2, min(nb, -(-n // (4 * nprocs))))
     a, factor = _safe_scale(A)
-    a = _shard(a, grid)
-    # stage 1 on the mesh: GSPMD shards the two-sided panel gemms
-    band, Vs, Ts = _he2hb_dist_fn(grid.mesh, n, nb, str(a.dtype))(a)
+    # stage 1 on the mesh: explicit shard_map panel pipeline (he2hb.cc)
+    band, Vs, Ts = he2hb_distributed(a, grid, nb=nb)
     # he2hbGather analogue: replicate the (cheap) band for the local chase
     band = jax.device_put(band, grid.replicated())
     out = hb2st(band, kd=nb, want_vectors=want_vectors,
@@ -114,9 +348,9 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     d, e, Q2 = out
     lam, Zt = (_stedc if method_eig == "dc" else steqr)(d, e)
     Z = jnp.matmul(Q2, Zt.astype(Q2.dtype), precision=lax.Precision.HIGHEST)
-    # redistribute + stage-1 back-transform (sharded gemms)
-    Z = _shard(Z, grid)
-    Z = unmtr_he2hb("left", "n", Vs, Ts, Z)
+    # stage-1 back-transform on the sharded reflector stack (one psum per
+    # block; unmtr_he2hb.cc)
+    Z = unmtr_he2hb_distributed(Vs, Ts, Z, grid, conj_q=False)
     return lam * factor, Z
 
 
@@ -161,7 +395,7 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     reference's LQ pre-step (svd.cc:224+).
     """
     from ..linalg.eig import _safe_scale
-    from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd, unmbr_ge2tb_factors
+    from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd
 
     m, n = A.shape[-2:]
     if min(m, n) < 8:
@@ -205,9 +439,13 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         return S, _shard(U, grid), VT
     k = n
     nb = max(2, min(nb, max(2, k - 1)))
+    # same padding-granularity clamp as heev_distributed
+    nprocs = grid.p * grid.q
+    if k >= 8 * nprocs:
+        nb = max(2, min(nb, -(-k // (4 * nprocs))))
     a, factor = _safe_scale(A)
-    a = _shard(a, grid)
-    band, Uf, Vf = _ge2tb_dist_fn(grid.mesh, m, n, nb, str(a.dtype))(a)
+    # stage 1 on the mesh: explicit shard_map panel pipeline (ge2tb.cc)
+    band, Uf, Vf = ge2tb_distributed(a, grid, nb=nb)
     band = jax.device_put(band, grid.replicated())
     sq = band[:k, :k]
     if k > 2:
@@ -224,14 +462,14 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     S, Ub, VTb = bdsqr(d, e, want_vectors=want_vectors)
     if not want_vectors:
         return S * factor, None, None
-    # U = Q_u [U2 Ub; 0],  VT = (VTb VT2) Q_v^H — sharded block-reflector gemms
+    # U = Q_u [U2 Ub; 0],  VT = (VTb VT2) Q_v^H — sharded reflector sweeps
+    # (one psum per block, _unmtr_he2hb_shard_fn)
     Uin = jnp.zeros((m, k), a.dtype).at[:k, :k].set(
         jnp.matmul(U2, Ub.astype(U2.dtype), precision=lax.Precision.HIGHEST))
-    U = unmbr_ge2tb_factors("left", "n", Uf, _shard(Uin, grid))
+    U = _apply_stacked_left(Uf[0], Uf[1], Uin, grid)
     Vin = jnp.conj(jnp.matmul(VTb.astype(VT2.dtype), VT2,
                               precision=lax.Precision.HIGHEST)).T
-    Vfull = unmbr_ge2tb_factors("left", "n", Vf,
-                                _shard(Vin, grid, col=False))
+    Vfull = _apply_stacked_left(Vf[0], Vf[1], Vin, grid)
     return S * factor, U, jnp.conj(Vfull).T
 
 

@@ -158,3 +158,70 @@ class TestScalapackEigSvdNorm:
         A = (M + M.T) / 2
         assert abs(sk.pdlansy("i", "l", np.tril(A)) -
                    np.abs(A).sum(1).max()) < 1e-6
+
+
+class TestStage1Sharding:
+    """Round-2 review: 'sharded stage 1 is asserted, not proven'.  These pin
+    the proof: the compiled shard_map module's per-device footprint must be a
+    real fraction of the full problem, and the designed collectives (and
+    nothing heavier) must appear in the HLO."""
+
+    def test_he2hb_per_device_resources(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from slate_tpu.parallel import ProcessGrid
+        from slate_tpu.parallel.eig_dist import AX, _he2hb_shard_fn
+
+        n, nb = 512, 32
+        rng = np.random.default_rng(0)
+        a = np.asarray(rng.standard_normal((n, n)), np.float32)
+        a = (a + a.T) / 2
+        grid = ProcessGrid(2, 4)
+        aj = jax.device_put(jnp.asarray(a),
+                            NamedSharding(grid.mesh, PartitionSpec(AX, None)))
+        comp = _he2hb_shard_fn(grid.mesh, n, nb, "float32").lower(aj).compile()
+        ma = comp.memory_analysis()
+        full = n * n * 4
+        # operand and band output live sharded: 1/8 of the full array each
+        assert ma.argument_size_in_bytes == full // 8
+        assert ma.output_size_in_bytes < full        # band+Vs sharded, Ts small
+        hlo = comp.as_text()
+        assert hlo.count("all-gather") >= 1          # panel gather
+        assert hlo.count("all-reduce") >= 1          # W = V^H A psum
+        # per-device flops a real fraction of the single-device program
+        g1 = ProcessGrid(1, 1, devices=jax.devices()[:1])
+        a1 = jax.device_put(jnp.asarray(a),
+                            NamedSharding(g1.mesh, PartitionSpec(AX, None)))
+        comp1 = _he2hb_shard_fn(g1.mesh, n, nb, "float32").lower(a1).compile()
+        f8 = comp.cost_analysis().get("flops", 0.0)
+        f1 = comp1.cost_analysis().get("flops", 0.0)
+        assert f8 < 0.35 * f1, (f8, f1)   # ~1/5.3 measured; replicated panel QR
+                                          # keeps it above the ideal 1/8
+
+    def test_he2hb_distributed_matches_single(self, rng):
+        from slate_tpu.parallel import ProcessGrid
+        from slate_tpu.parallel.eig_dist import he2hb_distributed
+        from slate_tpu.linalg.eig import he2hb
+
+        n, nb = 96, 8
+        a = rng.standard_normal((n, n))
+        a = (a + a.T) / 2
+        grid = ProcessGrid(2, 4)
+        band_d, Vs, Ts = he2hb_distributed(jnp.asarray(a, jnp.float64), grid,
+                                           nb=nb)
+        band_s, _, _ = he2hb(jnp.asarray(a, jnp.float64), nb=nb)
+        lam_d = np.linalg.eigvalsh(np.asarray(band_d))
+        lam_s = np.linalg.eigvalsh(np.asarray(band_s))
+        assert np.max(np.abs(lam_d - lam_s)) / np.max(np.abs(lam_s)) < 1e-12
+
+    def test_ge2tb_distributed_preserves_singular_values(self, rng):
+        from slate_tpu.parallel import ProcessGrid
+        from slate_tpu.parallel.eig_dist import ge2tb_distributed
+
+        m, n, nb = 120, 80, 8
+        a = rng.standard_normal((m, n))
+        grid = ProcessGrid(2, 4)
+        band, _, _ = ge2tb_distributed(jnp.asarray(a, jnp.float64), grid,
+                                       nb=nb)
+        s_d = np.linalg.svd(np.asarray(band), compute_uv=False)
+        s_s = np.linalg.svd(a, compute_uv=False)
+        assert np.max(np.abs(s_d - s_s)) / s_s[0] < 1e-12
