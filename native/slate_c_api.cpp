@@ -34,7 +34,9 @@ const char* kPrelude =
     "jax.config.update('jax_enable_x64', True)\n"  // d/z routines need f64
     "import numpy as np\n"
     "import slate_tpu\n"
-    "import slate_tpu.scalapack_api as sk\n";
+    "import slate_tpu.scalapack_api as sk\n"
+    "_handles = {}\n"          // matrix-object registry (handle API)
+    "_next_handle = [1]\n";
 
 int ensure_init() {
   if (!Py_IsInitialized()) {
@@ -380,6 +382,285 @@ int slate_dgesvd(char jobu, char jobvt, int64_t m, int64_t n, double* A,
       "    vm = np.frombuffer(Vbuf, np.float64).reshape((ldvt, -1), order='F')\n"
       "    vm[:vt.shape[0], :n] = vt\n"
       "info = 0\n",
+      c.locals);
+}
+
+// ---------------------------------------------------------------------------
+// factor / solve split + triangular solve + generalized eigen
+
+static int getrf_impl(char dtc, int64_t m, int64_t n, void* A, int64_t lda,
+                      int64_t* ipiv, int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t k = m < n ? m : n;
+  set_mem(c.locals, "Abuf", A, lda * n * esz);
+  set_mem(c.locals, "Pbuf", ipiv, k * 8);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:m, :n]\n"
+      "k = min(m, n)\n"
+      "pv = np.frombuffer(Pbuf, np.int64)[:k]\n"
+      "fac = sk.pdgetrf if dtc == 'd' else sk.psgetrf\n"
+      "lu, piv, info = fac(a.copy())\n"
+      "a[...] = lu\n"
+      "pv[...] = np.asarray(piv, np.int64)[:k]\n",
+      c.locals);
+}
+
+int slate_dgetrf(int64_t m, int64_t n, double* A, int64_t lda,
+                 int64_t* ipiv) {
+  return getrf_impl('d', m, n, A, lda, ipiv, 8);
+}
+
+int slate_sgetrf(int64_t m, int64_t n, float* A, int64_t lda, int64_t* ipiv) {
+  return getrf_impl('s', m, n, A, lda, ipiv, 4);
+}
+
+static int getrs_impl(char dtc, char trans, int64_t n, int64_t nrhs,
+                      const void* A, int64_t lda, const int64_t* ipiv,
+                      void* B, int64_t ldb, int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", const_cast<void*>(A), lda * n * esz);
+  set_mem(c.locals, "Pbuf", const_cast<int64_t*>(ipiv), n * 8);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_chr(c.locals, "trans", trans);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
+      "pv = np.frombuffer(Pbuf, np.int64)[:n]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "slv = sk.pdgetrs if dtc == 'd' else sk.psgetrs\n"
+      "b[...] = slv(trans, a.copy(), pv.copy(), b.copy())\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_dgetrs(char trans, int64_t n, int64_t nrhs, const double* A,
+                 int64_t lda, const int64_t* ipiv, double* B, int64_t ldb) {
+  return getrs_impl('d', trans, n, nrhs, A, lda, ipiv, B, ldb, 8);
+}
+
+int slate_sgetrs(char trans, int64_t n, int64_t nrhs, const float* A,
+                 int64_t lda, const int64_t* ipiv, float* B, int64_t ldb) {
+  return getrs_impl('s', trans, n, nrhs, A, lda, ipiv, B, ldb, 4);
+}
+
+static int trsm_impl(char dtc, char side, char uplo, char transa, char diag,
+                     int64_t m, int64_t n, double alpha, const void* A,
+                     int64_t lda, void* B, int64_t ldb, int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t ka = (side == 'l' || side == 'L') ? m : n;
+  set_mem(c.locals, "Abuf", const_cast<void*>(A), lda * ka * esz);
+  set_mem(c.locals, "Bbuf", B, ldb * n * esz);
+  set_chr(c.locals, "side", side);
+  set_chr(c.locals, "uplo", uplo);
+  set_chr(c.locals, "transa", transa);
+  set_chr(c.locals, "diag", diag);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_dbl(c.locals, "alpha", alpha);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "ka = m if side.lower() == 'l' else n\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:ka, :ka]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:m, :n]\n"
+      "fn = sk.pdtrsm if dtc == 'd' else sk.pstrsm\n"
+      "b[...] = fn(side, uplo, transa, diag, dt(alpha), a.copy(), b.copy())\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_dtrsm(char side, char uplo, char transa, char diag, int64_t m,
+                int64_t n, double alpha, const double* A, int64_t lda,
+                double* B, int64_t ldb) {
+  return trsm_impl('d', side, uplo, transa, diag, m, n, alpha, A, lda, B,
+                   ldb, 8);
+}
+
+int slate_strsm(char side, char uplo, char transa, char diag, int64_t m,
+                int64_t n, float alpha, const float* A, int64_t lda,
+                float* B, int64_t ldb) {
+  return trsm_impl('s', side, uplo, transa, diag, m, n, alpha, A, lda, B,
+                   ldb, 4);
+}
+
+int slate_dsygv(int64_t itype, char jobz, char uplo, int64_t n, double* A,
+                int64_t lda, double* B, int64_t ldb, double* W) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Bbuf", B, ldb * n * 8);
+  set_mem(c.locals, "Wbuf", W, n * 8);
+  set_int(c.locals, "itype", itype);
+  set_chr(c.locals, "jobz", jobz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  return run_code(
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:n, :n]\n"
+      "bm = np.frombuffer(Bbuf, np.float64).reshape((ldb, -1), order='F')[:n, :n]\n"
+      "w = np.frombuffer(Wbuf, np.float64)[:n]\n"
+      "lam, z = sk.pdsygv(int(itype), jobz, uplo, a.copy(), bm.copy())\n"
+      "w[...] = np.asarray(lam, np.float64)\n"
+      "if jobz.lower() == 'v' and z is not None:\n"
+      "    a[...] = np.asarray(z, np.float64)\n"
+      "# LAPACK dsygv contract: B returns its Cholesky factor triangle\n"
+      "Lf, info = sk.pdpotrf(uplo, bm.copy())\n"
+      "mask = np.tril(np.ones((n, n), bool)) if uplo.lower().startswith('l') "
+      "else np.triu(np.ones((n, n), bool))\n"
+      "bm[mask] = np.asarray(Lf, np.float64)[mask]\n",
+      c.locals);
+}
+
+// ---------------------------------------------------------------------------
+// matrix-object handles (reference slate_Matrix_create mirror)
+
+static int64_t matrix_create_impl(char dtc, int64_t m, int64_t n,
+                                  const void* data, int64_t lda,
+                                  int64_t esz) {
+  Call c;
+  if (!c.ok) return 0;
+  set_mem(c.locals, "Dbuf", const_cast<void*>(data), lda * n * esz);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_chr(c.locals, "dtc", dtc);
+  int64_t h = run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "arr = np.frombuffer(Dbuf, dt).reshape((lda, -1), order='F')[:m, :n]\n"
+      "_handles[_next_handle[0]] = np.ascontiguousarray(arr).copy()\n"
+      "info = _next_handle[0]\n"
+      "_next_handle[0] += 1\n",
+      c.locals);
+  return h > 0 ? h : 0;
+}
+
+int64_t slate_matrix_create_d(int64_t m, int64_t n, const double* data,
+                              int64_t lda) {
+  return matrix_create_impl('d', m, n, data, lda, 8);
+}
+
+int64_t slate_matrix_create_s(int64_t m, int64_t n, const float* data,
+                              int64_t lda) {
+  return matrix_create_impl('s', m, n, data, lda, 4);
+}
+
+static int matrix_read_impl(char dtc, int64_t h, void* out, int64_t ld,
+                            int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "h", h);
+  set_int(c.locals, "ld", ld);
+  set_chr(c.locals, "dtc", dtc);
+  // stage 1: look up shape so the out view can be sized server-side
+  int rc = run_code(
+      "a = _handles.get(int(h))\n"
+      "info = 0 if a is not None else -1\n"
+      "if a is not None:\n"
+      "    rows, cols = a.shape\n",
+      c.locals);
+  if (rc != 0) return rc;
+  PyObject* ro = PyDict_GetItemString(c.locals, "rows");
+  PyObject* co = PyDict_GetItemString(c.locals, "cols");
+  if (ro == nullptr || co == nullptr) return -1;
+  int64_t cols = PyLong_AsLongLong(co);
+  (void)ro;
+  set_mem(c.locals, "Obuf", out, ld * cols * esz);
+  return run_code(
+      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "om = np.frombuffer(Obuf, dt).reshape((ld, -1), order='F')\n"
+      "om[:rows, :cols] = a\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_matrix_read_d(int64_t h, double* out, int64_t ld) {
+  return matrix_read_impl('d', h, out, ld, 8);
+}
+
+int slate_matrix_read_s(int64_t h, float* out, int64_t ld) {
+  return matrix_read_impl('s', h, out, ld, 4);
+}
+
+void slate_matrix_destroy(int64_t h) {
+  Call c;
+  if (!c.ok) return;
+  set_int(c.locals, "h", h);
+  run_code("_handles.pop(int(h), None)\ninfo = 0\n", c.locals);
+}
+
+int slate_matrix_gemm(char transa, char transb, double alpha, int64_t hA,
+                      int64_t hB, double beta, int64_t hC) {
+  Call c;
+  if (!c.ok) return -999;
+  set_chr(c.locals, "ta", transa);
+  set_chr(c.locals, "tb", transb);
+  set_dbl(c.locals, "alpha", alpha);
+  set_dbl(c.locals, "beta", beta);
+  set_int(c.locals, "ha", hA);
+  set_int(c.locals, "hb", hB);
+  set_int(c.locals, "hc", hC);
+  return run_code(
+      "a, b, cm = (_handles.get(int(x)) for x in (ha, hb, hc))\n"
+      "if a is None or b is None or cm is None:\n"
+      "    info = -1\n"
+      "else:\n"
+      "    fn = sk.pdgemm if cm.dtype == np.float64 else sk.psgemm\n"
+      "    _handles[int(hc)] = np.asarray(\n"
+      "        fn(ta, tb, cm.dtype.type(alpha), a, b, cm.dtype.type(beta),\n"
+      "           cm.copy()), cm.dtype)\n"
+      "    info = 0\n",
+      c.locals);
+}
+
+int slate_matrix_potrf(int64_t h, char uplo) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "h", h);
+  set_chr(c.locals, "uplo", uplo);
+  return run_code(
+      "a = _handles.get(int(h))\n"
+      "if a is None:\n"
+      "    info = -1\n"
+      "else:\n"
+      "    fn = sk.pdpotrf if a.dtype == np.float64 else sk.pspotrf\n"
+      "    Lf, info = fn(uplo, a.copy())\n"
+      "    if info == 0:\n"
+      "        _handles[int(h)] = np.asarray(Lf, a.dtype)\n",
+      c.locals);
+}
+
+int slate_matrix_gesv(int64_t hA, int64_t hB) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "ha", hA);
+  set_int(c.locals, "hb", hB);
+  return run_code(
+      "a, b = _handles.get(int(ha)), _handles.get(int(hb))\n"
+      "if a is None or b is None:\n"
+      "    info = -1\n"
+      "else:\n"
+      "    fac = sk.pdgetrf if a.dtype == np.float64 else sk.psgetrf\n"
+      "    slv = sk.pdgetrs if a.dtype == np.float64 else sk.psgetrs\n"
+      "    lu, piv, info = fac(a.copy())\n"
+      "    if info == 0:\n"
+      "        _handles[int(hb)] = np.asarray(\n"
+      "            slv('n', lu, piv, b.copy()), b.dtype)\n",
       c.locals);
 }
 

@@ -10,7 +10,7 @@ every routine family the reference's lapack_api covers —
     gesv gesv_mixed getrf getrs getri gecon                  (LU)
     posv potrf potrs potri pocon                             (Cholesky)
     gels                                                     (least squares)
-    heev heevd syev syevd gesvd                              (eig / SVD)
+    heev heevd syev syevd hegv sygv gesvd                    (eig / SVD)
     trcon                                                    (condition)
 
 — is exposed with all four type prefixes (s, d, c, z): ``dgesv(a, b)``,
@@ -245,6 +245,14 @@ def _heev(dt, jobz, uplo, a, *, sy=False):
             else (np.asarray(lam), None))
 
 
+def _hegv(dt, itype, jobz, uplo, a, b, *, sy=False):
+    a, b = _as(dt, a, b)
+    lam, z = _la.hegv(int(itype), a, b, _opts(), uplo=uplo,
+                      want_vectors=jobz.lower() == "v")
+    return ((np.asarray(lam), np.asarray(z)) if jobz.lower() == "v"
+            else (np.asarray(lam), None))
+
+
 def _complete_basis(u: np.ndarray, full: int) -> np.ndarray:
     """Extend orthonormal columns u (m x k) to a full m x m orthogonal basis:
     QR of [u | I] keeps the leading k columns equal to u (up to sign, fixed)."""
@@ -300,6 +308,7 @@ _FAMILIES = {
     "gels": (_gels, {}),
     "heev": (_heev, {}), "heevd": (_heev, {}),
     "syev": (_heev, {"sy": True}), "syevd": (_heev, {"sy": True}),
+    "hegv": (_hegv, {}), "sygv": (_hegv, {"sy": True}),
     "gesvd": (_gesvd, {}),
 }
 
@@ -309,6 +318,7 @@ _SKIP = {
     ("s", "her2k"), ("d", "her2k"), ("s", "lanhe"), ("d", "lanhe"),
     ("s", "heev"), ("d", "heev"), ("s", "heevd"), ("d", "heevd"),
     ("c", "syev"), ("z", "syev"), ("c", "syevd"), ("z", "syevd"),
+    ("s", "hegv"), ("d", "hegv"), ("c", "sygv"), ("z", "sygv"),
 }
 
 __all__ = []

@@ -401,11 +401,10 @@ def _supports_distributed(name, args, kw) -> bool:
         a = np.asarray(args[0])
         if a.ndim != 2:
             return False
-        # factorization handles wide directly and moderately tall via square
-        # embedding (the O(m^3) embedding must not dwarf the O(m n^2) job);
-        # solves need square
-        return a.shape[0] <= 2 * a.shape[1] \
-            if name == "getrf" else a.shape[0] == a.shape[1]
+        # getrf handles every shape on the mesh (wide via the leading-block
+        # split, tall via the 1-D TSLU — the round-2 m <= 2n embedding guard
+        # is gone); solves need square
+        return True if name == "getrf" else a.shape[0] == a.shape[1]
     return True
 
 

@@ -147,6 +147,114 @@ int main(void) {
     free(A); free(As); free(S); free(U); free(VT);
   }
 
+  /* getrf + getrs split */
+  {
+    double *A = malloc(n * n * 8), *As = malloc(n * n * 8);
+    double *B = malloc(n * nrhs * 8), *Bs = malloc(n * nrhs * 8);
+    int64_t *ipiv = malloc(n * 8);
+    for (int64_t i = 0; i < n * n; ++i) As[i] = A[i] = frand();
+    for (int64_t i = 0; i < n * nrhs; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dgetrf(n, n, A, n, ipiv);
+    if (info == 0) info = slate_dgetrs('n', n, nrhs, A, n, ipiv, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = -Bs[i + j * n];
+        for (int64_t k = 0; k < n; ++k) acc += As[i + k * n] * B[k + j * n];
+        double d = fabs(acc);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dgetrf+s", maxe, 1e-10);
+    /* transposed solve through the same factors */
+    for (int64_t i = 0; i < n * nrhs; ++i) B[i] = Bs[i];
+    info = slate_dgetrs('t', n, nrhs, A, n, ipiv, B, n);
+    maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = -Bs[i + j * n];
+        for (int64_t k = 0; k < n; ++k) acc += As[k + i * n] * B[k + j * n];
+        double d = fabs(acc);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dgetrs-t", maxe, 1e-10);
+    free(A); free(As); free(B); free(Bs); free(ipiv);
+  }
+
+  /* trsm */
+  {
+    double *A = malloc(n * n * 8), *B = malloc(n * nrhs * 8), *Bs = malloc(n * nrhs * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i)
+        A[i + j * n] = i > j ? frand() : (i == j ? 2.0 + frand() : 0.0);
+    for (int64_t i = 0; i < n * nrhs; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dtrsm('l', 'l', 'n', 'n', n, nrhs, 1.5, A, n, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = -1.5 * Bs[i + j * n];
+        for (int64_t k = 0; k <= i; ++k) acc += A[i + k * n] * B[k + j * n];
+        double d = fabs(acc);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dtrsm", maxe, 1e-10);
+    free(A); free(B); free(Bs);
+  }
+
+  /* sygv (itype 1, values) */
+  {
+    double *A = malloc(n * n * 8), *Bm = malloc(n * n * 8), *G = malloc(n * n * 8);
+    double *As = malloc(n * n * 8), *Bsv = malloc(n * n * 8), *W = malloc(n * 8);
+    for (int64_t i = 0; i < n * n; ++i) G[i] = frand();
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        A[i + j * n] = 0.5 * (G[i + j * n] + G[j + i * n]);
+        double acc = (i == j) ? (double)n : 0.0;
+        for (int64_t k = 0; k < n; ++k) acc += G[i + k * n] * G[j + k * n];
+        Bm[i + j * n] = acc;
+      }
+    for (int64_t i = 0; i < n * n; ++i) { As[i] = A[i]; Bsv[i] = Bm[i]; }
+    int info = slate_dsygv(1, 'v', 'l', n, A, n, Bm, n, W);
+    /* residual: A z = w B z per eigenpair */
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < n && info == 0; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double az = 0, bz = 0;
+        for (int64_t k = 0; k < n; ++k) {
+          az += As[i + k * n] * A[k + j * n];
+          bz += Bsv[i + k * n] * A[k + j * n];
+        }
+        double d = fabs(az - W[j] * bz);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("dsygv", maxe, 1e-7);
+    free(A); free(Bm); free(G); free(As); free(Bsv); free(W);
+  }
+
+  /* matrix-object handles: create -> gemm -> gesv -> read */
+  {
+    double *A = malloc(n * n * 8), *B = malloc(n * nrhs * 8), *X = malloc(n * nrhs * 8);
+    for (int64_t i = 0; i < n * n; ++i) A[i] = frand();
+    for (int64_t i = 0; i < n; ++i) A[i + i * n] += n;   /* well conditioned */
+    for (int64_t i = 0; i < n * nrhs; ++i) B[i] = frand();
+    int64_t hA = slate_matrix_create_d(n, n, A, n);
+    int64_t hB = slate_matrix_create_d(n, nrhs, B, n);
+    int ok = hA > 0 && hB > 0;
+    int info = ok ? slate_matrix_gesv(hA, hB) : -1;
+    if (info == 0) info = slate_matrix_read_d(hB, X, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t j = 0; j < nrhs; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = -B[i + j * n];
+        for (int64_t k = 0; k < n; ++k) acc += A[i + k * n] * X[k + j * n];
+        double d = fabs(acc);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("handles", maxe, 1e-10);
+    slate_matrix_destroy(hA);
+    slate_matrix_destroy(hB);
+    free(A); free(B); free(X);
+  }
+
   /* gridinit path: same posv through a 2x4 grid when 8 devices exist */
   {
     if (slate_gridinit(2, 4) == 0) {

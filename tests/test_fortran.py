@@ -1,0 +1,43 @@
+"""Compiled Fortran smoke tier (reference: tools/fortran wrappers + its
+Fortran examples).  Skips when no Fortran compiler is present (the dev image
+carries none); CI installs gfortran and runs it for real."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_ROOT, "native")
+
+
+def _fc():
+    for cand in ("gfortran", "flang", "ifort"):
+        if shutil.which(cand):
+            return cand
+    return None
+
+
+@pytest.mark.skipif(_fc() is None, reason="no Fortran compiler")
+def test_fortran_smoke(tmp_path):
+    build = subprocess.run(["make", "-C", _NATIVE, "libslate_c_api.so"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    exe = str(tmp_path / "smoke")
+    fc = subprocess.run(
+        [_fc(), os.path.join(_ROOT, "tools", "fortran", "slate_tpu.f90"),
+         os.path.join(_ROOT, "tools", "fortran", "smoke.f90"),
+         "-J", str(tmp_path), "-L", _NATIVE, "-lslate_c_api",
+         f"-Wl,-rpath,{_NATIVE}", "-o", exe],
+        capture_output=True, text=True, timeout=120)
+    assert fc.returncode == 0, fc.stderr[-2000:]
+
+    env = dict(os.environ)
+    env.update({"SLATE_TPU_ROOT": _ROOT, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""})
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=600,
+                         env=env)
+    assert run.returncode == 0, run.stdout[-2000:] + run.stderr[-2000:]
+    assert "FORTRAN PASS" in run.stdout

@@ -86,6 +86,53 @@ module slate_tpu
        integer(c_int64_t), value :: m, n, lda
        real(c_double), intent(in) :: A(*)
      end function slate_dlange
+
+     integer(c_int) function slate_dgetrf(m, n, A, lda, ipiv) &
+          bind(c, name="slate_dgetrf")
+       import :: c_int, c_int64_t, c_double
+       integer(c_int64_t), value :: m, n, lda
+       real(c_double), intent(inout) :: A(*)
+       integer(c_int64_t), intent(out) :: ipiv(*)
+     end function slate_dgetrf
+
+     integer(c_int) function slate_dgetrs(trans, n, nrhs, A, lda, ipiv, &
+          B, ldb) bind(c, name="slate_dgetrs")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: trans
+       integer(c_int64_t), value :: n, nrhs, lda, ldb
+       real(c_double), intent(in) :: A(*)
+       integer(c_int64_t), intent(in) :: ipiv(*)
+       real(c_double), intent(inout) :: B(*)
+     end function slate_dgetrs
+
+     integer(c_int) function slate_dtrsm(side, uplo, transa, diag, m, n, &
+          alpha, A, lda, B, ldb) bind(c, name="slate_dtrsm")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: side, uplo, transa, diag
+       integer(c_int64_t), value :: m, n, lda, ldb
+       real(c_double), value :: alpha
+       real(c_double), intent(in) :: A(*)
+       real(c_double), intent(inout) :: B(*)
+     end function slate_dtrsm
+
+     integer(c_int) function slate_dsygv(itype, jobz, uplo, n, A, lda, &
+          B, ldb, W) bind(c, name="slate_dsygv")
+       import :: c_int, c_int64_t, c_double, c_char
+       integer(c_int64_t), value :: itype
+       character(kind=c_char), value :: jobz, uplo
+       integer(c_int64_t), value :: n, lda, ldb
+       real(c_double), intent(inout) :: A(*), B(*)
+       real(c_double), intent(out) :: W(*)
+     end function slate_dsygv
+
+     integer(c_int) function slate_dgesvd(jobu, jobvt, m, n, A, lda, S, &
+          U, ldu, VT, ldvt) bind(c, name="slate_dgesvd")
+       import :: c_int, c_int64_t, c_double, c_char
+       character(kind=c_char), value :: jobu, jobvt
+       integer(c_int64_t), value :: m, n, lda, ldu, ldvt
+       real(c_double), intent(inout) :: A(*)
+       real(c_double), intent(out) :: S(*), U(*), VT(*)
+     end function slate_dgesvd
   end interface
 
 end module slate_tpu

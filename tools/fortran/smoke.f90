@@ -1,0 +1,57 @@
+! Fortran smoke test over the slate_tpu C API (compiled + run in CI with
+! gfortran; the local image carries no Fortran compiler, so
+! tests/test_fortran.py skips unless one is present).
+!
+!   gfortran tools/fortran/slate_tpu.f90 tools/fortran/smoke.f90 \
+!     -I. -L native -lslate_c_api -Wl,-rpath,native -o smoke && ./smoke
+program smoke
+  use slate_tpu
+  use iso_c_binding
+  implicit none
+  integer(c_int64_t), parameter :: n = 12, nrhs = 2
+  real(c_double) :: A(n, n), Asave(n, n), B(n, nrhs), Bsave(n, nrhs)
+  real(c_double) :: W(n), resid
+  integer(c_int64_t) :: ipiv(n)
+  integer(c_int) :: info
+  integer :: i, j, k
+  integer :: nfail
+
+  nfail = 0
+  call random_number(A)
+  A = A - 0.5d0
+  do i = 1, int(n)
+     A(i, i) = A(i, i) + real(n, c_double)
+  end do
+  Asave = A
+  call random_number(B)
+  Bsave = B
+
+  ! getrf + getrs
+  info = slate_dgetrf(n, n, A, n, ipiv)
+  if (info /= 0) nfail = nfail + 1
+  info = slate_dgetrs('n', n, nrhs, A, n, ipiv, B, n)
+  if (info /= 0) nfail = nfail + 1
+  resid = 0.0d0
+  do j = 1, int(nrhs)
+     do i = 1, int(n)
+        resid = max(resid, abs(sum(Asave(i, :) * B(:, j)) - Bsave(i, j)))
+     end do
+  end do
+  print '(a, es10.3)', 'fortran getrf+s resid ', resid
+  if (resid > 1.0d-10) nfail = nfail + 1
+
+  ! syev values of the symmetrized matrix
+  A = 0.5d0 * (Asave + transpose(Asave))
+  info = slate_dsyev('n', 'l', n, A, n, W)
+  if (info /= 0) nfail = nfail + 1
+  do k = 2, int(n)
+     if (W(k) < W(k - 1)) nfail = nfail + 1   ! ascending contract
+  end do
+  print '(a, i0)', 'fortran nfail = ', nfail
+  if (nfail == 0) then
+     print '(a)', 'FORTRAN PASS'
+  else
+     print '(a)', 'FORTRAN FAIL'
+     stop 1
+  end if
+end program smoke
