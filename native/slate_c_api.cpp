@@ -406,8 +406,19 @@ static int getrf_impl(char dtc, int64_t m, int64_t n, void* A, int64_t lda,
       "pv = np.frombuffer(Pbuf, np.int64)[:k]\n"
       "fac = sk.pdgetrf if dtc == 'd' else sk.psgetrf\n"
       "lu, piv, info = fac(a.copy())\n"
+      "piv = np.asarray(piv, np.int64)\n"
+      "lu = np.asarray(lu)\n"
+      "if m > k:\n"
+      "    # LAPACK ipiv stops at k swaps; rows below k must sit where those\n"
+      "    # k interchanges (alone) put them, or the truncated ipiv and the\n"
+      "    # returned L rows disagree for tall factors\n"
+      "    import slate_tpu.linalg.lu as _lum\n"
+      "    invp = np.argsort(np.asarray(_lum.pivots_to_perm(piv)))\n"
+      "    piv2 = np.concatenate([piv[:k], np.arange(k + 1, m + 1)])\n"
+      "    perm2 = np.asarray(_lum.pivots_to_perm(piv2))\n"
+      "    lu = lu[invp[perm2]]\n"
       "a[...] = lu\n"
-      "pv[...] = np.asarray(piv, np.int64)[:k]\n",
+      "pv[...] = piv[:k]\n",
       c.locals);
 }
 
@@ -515,15 +526,22 @@ int slate_dsygv(int64_t itype, char jobz, char uplo, int64_t n, double* A,
       "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:n, :n]\n"
       "bm = np.frombuffer(Bbuf, np.float64).reshape((ldb, -1), order='F')[:n, :n]\n"
       "w = np.frombuffer(Wbuf, np.float64)[:n]\n"
-      "lam, z = sk.pdsygv(int(itype), jobz, uplo, a.copy(), bm.copy())\n"
-      "w[...] = np.asarray(lam, np.float64)\n"
-      "if jobz.lower() == 'v' and z is not None:\n"
-      "    a[...] = np.asarray(z, np.float64)\n"
-      "# LAPACK dsygv contract: B returns its Cholesky factor triangle\n"
-      "Lf, info = sk.pdpotrf(uplo, bm.copy())\n"
-      "mask = np.tril(np.ones((n, n), bool)) if uplo.lower().startswith('l') "
+      "# factor B first (LAPACK order: non-SPD B -> info = n + i, eigensolve\n"
+      "# skipped); the driver re-factors internally — an accepted duplicate\n"
+      "# worth ~n^3/3 next to the O(n^3) eigensolve, in exchange for the\n"
+      "# returned info and triangle coming from ONE factorization\n"
+      "Lf, finfo = sk.pdpotrf(uplo, bm.copy())\n"
+      "if finfo != 0:\n"
+      "    info = int(n) + int(finfo)\n"
+      "else:\n"
+      "    mask = np.tril(np.ones((n, n), bool)) if uplo.lower().startswith('l') "
       "else np.triu(np.ones((n, n), bool))\n"
-      "bm[mask] = np.asarray(Lf, np.float64)[mask]\n",
+      "    lam, z = sk.pdsygv(int(itype), jobz, uplo, a.copy(), bm.copy())\n"
+      "    w[...] = np.asarray(lam, np.float64)\n"
+      "    if jobz.lower() == 'v' and z is not None:\n"
+      "        a[...] = np.asarray(z, np.float64)\n"
+      "    bm[mask] = np.asarray(Lf, np.float64)[mask]\n"
+      "    info = 0\n",
       c.locals);
 }
 

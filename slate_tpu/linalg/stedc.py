@@ -125,9 +125,15 @@ def _deflate(d_sorted, z_sorted, rho):
     return d, z2, scale, eps
 
 
-def _merge(d1, Q1, d2, Q2, rho_raw):
+def _merge(d1, Q1, d2, Q2, rho_raw, grid=None):
     """One D&C merge (stedc_merge + stedc_z_vector + stedc_secular +
-    stedc_solve): rank-one update D + rho z z^T in the blkdiag(Q1, Q2) basis."""
+    stedc_solve): rank-one update D + rho z z^T in the blkdiag(Q1, Q2) basis.
+
+    With ``grid`` (a ProcessGrid), the two basis-update gemms — the O(m³)
+    flops of the merge — run sharded over the mesh (src/stedc_merge.cc keeps
+    Q distributed the same way); the secular solve and Loewner build are
+    O(m²·iters) and stay replicated, like the reference's per-rank secular
+    loop."""
     dt = d1.dtype
     n1 = d1.shape[0]
     n2 = d2.shape[0]
@@ -207,17 +213,29 @@ def _merge(d1, Q1, d2, Q2, rho_raw):
     # back to the original basis: Z = blkdiag(Q1, Q2)[:, order] @ V.  Undo the
     # sort on V's rows, then apply the two diagonal blocks separately (the
     # laed3 structure) — two (n_i x n_i x m) gemms, half the flops of one
-    # dense m^3 product against materialized zero blocks.
+    # dense m^3 product against materialized zero blocks.  On a grid these
+    # two products (the merge's O(m³) mass) ride the mesh.
     Vp = jnp.zeros_like(V).at[order].set(V)
-    Ztop = jnp.matmul(Q1, Vp[:n1], precision=lax.Precision.HIGHEST)
-    Zbot = jnp.matmul(Q2, Vp[n1:], precision=lax.Precision.HIGHEST)
+    if grid is not None:
+        from ..parallel.summa import gemm_padded
+
+        Ztop = gemm_padded(Q1, Vp[:n1], grid)
+        Zbot = gemm_padded(Q2, Vp[n1:], grid)
+    else:
+        Ztop = jnp.matmul(Q1, Vp[:n1], precision=lax.Precision.HIGHEST)
+        Zbot = jnp.matmul(Q2, Vp[n1:], precision=lax.Precision.HIGHEST)
     return lam, jnp.concatenate([Ztop, Zbot], axis=0)
 
 
-_merge_jit = jax.jit(_merge)  # caches per input shape/dtype
+_merge_jit = jax.jit(_merge)  # caches per input shape/dtype (grid=None path)
 
 
-def _stedc_rec(d, e) -> Tuple[jax.Array, jax.Array]:
+# merges below this size gain nothing from the mesh (collective latency
+# dwarfs the gemm); the top log2(n/threshold) merges carry ~all the flops
+_DIST_MERGE_MIN = 1024
+
+
+def _stedc_rec(d, e, grid=None) -> Tuple[jax.Array, jax.Array]:
     n = d.shape[0]
     if n <= _BASE_N:
         from .eig import _assemble_tridiag
@@ -227,17 +245,27 @@ def _stedc_rec(d, e) -> Tuple[jax.Array, jax.Array]:
     rho = e[mid - 1]
     d1 = jnp.concatenate([d[: mid - 1], (d[mid - 1] - rho)[None]])
     d2 = jnp.concatenate([(d[mid] - rho)[None], d[mid + 1:]])
-    lam1, Z1 = _stedc_rec(d1, e[: mid - 1])
-    lam2, Z2 = _stedc_rec(d2, e[mid:])
+    lam1, Z1 = _stedc_rec(d1, e[: mid - 1], grid)
+    lam2, Z2 = _stedc_rec(d2, e[mid:], grid)
+    if grid is not None and n >= _DIST_MERGE_MIN:
+        # eager composition: the O(m³) gemms inside are themselves jitted
+        # sharded programs; the replicated secular/Loewner stages are single
+        # fused lax ops either way
+        return _merge(lam1, Z1, lam2, Z2, rho, grid)
     return _merge_jit(lam1, Z1, lam2, Z2, rho)
 
 
-def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):
+def stedc(d, e, Z: Optional[jax.Array] = None, opts=None, grid=None):
     """Divide & conquer tridiagonal eigensolver (src/stedc.cc family).
 
     Same contract as steqr: returns (ascending eigenvalues, Q), premultiplied
     by ``Z`` when given.  The off-diagonal may be signed; a diagonal similarity
     normalizes it nonnegative first (signs folded into Q).
+
+    ``grid``: a ProcessGrid — merges at and above ``_DIST_MERGE_MIN`` run
+    their basis-update gemms sharded over the mesh (the distributed form of
+    src/stedc.cc, whose Q stays a distributed matrix throughout), as does the
+    final Z @ Q product.
     """
     d = jnp.asarray(d)
     e = jnp.asarray(e)
@@ -249,13 +277,18 @@ def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):
     if n > 1:
         sgn = jnp.where(e < 0, -1.0, 1.0).astype(d.dtype)
         S = jnp.concatenate([jnp.ones((1,), d.dtype), jnp.cumprod(sgn)])
-        lam, Q = _stedc_rec(d, jnp.abs(e))
+        lam, Q = _stedc_rec(d, jnp.abs(e), grid)
         Q = S[:, None] * Q
     else:
         lam, Q = d, jnp.ones((1, 1), d.dtype)
     if Z is not None:
-        Q = jnp.matmul(Z.astype(Q.dtype) if Z.dtype != Q.dtype else Z, Q,
-                       precision=lax.Precision.HIGHEST)
+        Zc = Z.astype(Q.dtype) if Z.dtype != Q.dtype else Z
+        if grid is not None and n >= _DIST_MERGE_MIN:
+            from ..parallel.summa import gemm_padded
+
+            Q = gemm_padded(Zc, Q, grid)
+        else:
+            Q = jnp.matmul(Zc, Q, precision=lax.Precision.HIGHEST)
     return lam, Q
 
 

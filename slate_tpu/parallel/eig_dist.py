@@ -346,8 +346,16 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         lam = sterf(d, e)
         return lam * factor, None
     d, e, Q2 = out
-    lam, Zt = (_stedc if method_eig == "dc" else steqr)(d, e)
-    Z = jnp.matmul(Q2, Zt.astype(Q2.dtype), precision=lax.Precision.HIGHEST)
+    if method_eig == "dc":
+        # distributed D&C: the merge basis-update gemms ride the mesh
+        lam, Zt = _stedc(d, e, grid=grid)
+    else:
+        lam, Zt = steqr(d, e)
+    # chase back-transform is the same O(n³) order as the merges — it rides
+    # the mesh too rather than replicating on every device
+    from .summa import gemm_padded
+
+    Z = gemm_padded(Q2, Zt.astype(Q2.dtype), grid)
     # stage-1 back-transform on the sharded reflector stack (one psum per
     # block; unmtr_he2hb.cc)
     Z = unmtr_he2hb_distributed(Vs, Ts, Z, grid, conj_q=False)
