@@ -61,8 +61,13 @@ BASELINES = {
     "potrf": 13000.0,  # cuSOLVER/MAGMA dpotrf n=16384 (gemm-rich, near dgemm rate)
     "getrf": 9000.0,   # dgetrf n=16384 (pivoting + panel overhead)
     "gels": 9000.0,    # tall dgels 131072x4096, cholqr path
-    "heev": 150.0,     # dsyevd values n=4096 on 4n^3/3 model
-    "svd": 100.0,      # dgesvd values n=4096 on 8n^3/3 model
+    "heev": 300.0,     # dsyevd values n=16384 on 4n^3/3 model (the n=4096
+                       # config used 150; published-order A100 rates roughly
+                       # double from 4k to 16k as the tridiagonal stage
+                       # amortizes — VERDICT r2 asked for the BASELINE-scale
+                       # config, so the denominator moves with it)
+    "svd": 200.0,      # dgesvd values n=16384 on 8n^3/3 model (was 100 at
+                       # n=4096; same scaling rationale)
     "norm": 450.0,     # dlange Fro n=16384: bandwidth-bound, ~1.8 TB/s HBM
                        # at 8 B/elem and 2 flops/elem -> ~450 GFLOP/s
 }
@@ -268,13 +273,14 @@ def child_gels(cpu_fallback):
 
 
 def child_heev(cpu_fallback):
-    """Hermitian eigenvalues (BASELINE config #5a; reference test_heev). Times
-    the framework's heev values driver (linalg/eig.py default = fused XLA
-    eigh). Model: 4n^3/3 (tridiagonal reduction dominates)."""
+    """Hermitian eigenvalues at BASELINE scale (config #5a: the n=20,000-class
+    problem; reference test_heev). Times the framework's heev values driver
+    (linalg/eig.py default = fused XLA eigh — QDWH spectral D&C, all-matmul).
+    Model: 4n^3/3 (tridiagonal reduction dominates)."""
     import jax
     import jax.numpy as jnp
 
-    n = 1024 if cpu_fallback else 4096
+    n = 1024 if cpu_fallback else 16384
     key = jax.random.PRNGKey(0)
     m = jax.random.normal(key, (n, n), dtype=jnp.float32)
     a = (m + m.T) / 2.0
@@ -294,12 +300,13 @@ def child_heev(cpu_fallback):
 
 
 def child_svd(cpu_fallback):
-    """Singular values (BASELINE config #5b; reference test_svd). Times the
-    framework's svd_vals path (linalg/svd.py). Model: 8n^3/3."""
+    """Singular values at BASELINE scale (config #5b: the n=20,000-class
+    problem; reference test_svd). Times the framework's svd_vals path
+    (linalg/svd.py). Model: 8n^3/3."""
     import jax
     import jax.numpy as jnp
 
-    n = 1024 if cpu_fallback else 4096
+    n = 1024 if cpu_fallback else 16384
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), dtype=jnp.float32)
 

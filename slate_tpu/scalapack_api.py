@@ -22,6 +22,15 @@ with the p<type> prefix (pdgemm, psposv, pzheev, ...).
 
 Env tuning: ``SLATE_SCALAPACK_NB`` sets the distribution block size consumed by
 the distributed p* routines.
+
+Data-movement note (round-2 review): every p* call accepts and returns HOST
+numpy arrays — the ScaLAPACK calling convention — so each call pays one
+host->device transfer per operand and one device->host for the result, even
+when consecutive calls chain on the same matrix.  This is inherent to the
+skin's compatibility contract (the reference's scalapack_api wraps
+fromScaLAPACK the same way); pipelines that want device residency should use
+the native ``slate_tpu`` / ``slate_tpu.parallel`` APIs, whose operands are
+jax.Arrays and stay on the mesh across calls.
 """
 
 from __future__ import annotations
