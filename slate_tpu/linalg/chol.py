@@ -190,7 +190,8 @@ def potrf(A, opts=None, uplo=None):
             # construction is consumed by every driver)
             from ..parallel import potrf_distributed
 
-            L = potrf_distributed(Af, grid, nb=min(opts.block_size, n))
+            L = potrf_distributed(Af, grid, nb=min(opts.block_size, n),
+                                  lookahead=opts.lookahead)
         elif target == Target.XLA:
             L = jnp.tril(lax.linalg.cholesky(Af))
         else:

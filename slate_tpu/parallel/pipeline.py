@@ -1,6 +1,9 @@
 """Software-pipelined (lookahead) distributed Cholesky over an explicit
-shard_map — the demonstration of the reference's lookahead task pipeline in
-SPMD form.
+shard_map — the reference's lookahead task pipeline in SPMD form.
+
+This is a production path: ``potrf_distributed(..., lookahead >= 2)`` — and
+through it the ``slate.potrf`` driver's ``Option::Lookahead`` — routes here
+(round-2 review: "lookahead is a demo no production driver calls").
 
 Reference analogue: ``src/potrf.cc:84-195`` — the OpenMP task DAG gives the
 next panel column a *high-priority* update task so its factorization and

@@ -133,16 +133,28 @@ def _pad_spd(Af: jax.Array, mult: int):
 
 
 def potrf_distributed(Af: jax.Array, grid: ProcessGrid, nb: int = 256,
-                      method: str = "auto") -> jax.Array:
+                      method: str = "auto",
+                      lookahead: int = 1) -> jax.Array:
     """Distributed lower Cholesky of a full Hermitian array. Returns sharded L.
 
     method: "unroll" (O(nt) program, optimal flops), "loop" (O(1) program,
     masked updates — survives large panel counts), or "auto" which switches to
     the loop body past _POTRF_UNROLL_MAX_NT panels (the BASELINE n=16384
     nb=256 configuration is 64 panels, where unrolled compiles cost minutes).
+
+    lookahead >= 2 routes to the explicit software pipeline
+    (``pipeline.potrf_pipelined``): the next panel's column is updated first
+    so its factorization overlaps the wide trailing collective — the
+    reference's lookahead machinery (potrf.cc:84-195) made explicit instead
+    of trusting XLA's async scheduler.  Depth-1 (the default) keeps the
+    GSPMD bodies, whose single fused program XLA already overlaps.
     """
     n0 = Af.shape[-1]
     nb = max(1, min(nb, n0))
+    if lookahead >= 2:
+        from .pipeline import potrf_pipelined
+
+        return potrf_pipelined(Af, grid, nb=nb)
     unit = _lcm(grid.p, grid.q)
     use_loop = method == "loop" or (
         method == "auto" and -(-n0 // nb) > _POTRF_UNROLL_MAX_NT)

@@ -512,3 +512,22 @@ class TestDistributedAtScale:
         assert res < 1e-4          # f32 at n=2048
         assert int(info) == 0
         assert sorted(np.asarray(perm).tolist()) == list(range(n))
+
+
+class TestLookaheadRouting:
+    def test_driver_lookahead_routes_pipeline(self, rng):
+        """Option::Lookahead >= 2 through the public potrf driver takes the
+        explicit software pipeline (potrf.cc:84-195 analogue) — same factor."""
+        import slate_tpu as slate
+        from slate_tpu.parallel import ProcessGrid
+
+        n = 64
+        g = rng.standard_normal((n, n))
+        spd = g @ g.T + n * np.eye(n)
+        grid = ProcessGrid(2, 4)
+        A = slate.HermitianMatrix.from_array("lower", spd.copy(), nb=16,
+                                             grid=grid)
+        L, info = slate.potrf(A, opts={"block_size": 16, "lookahead": 2})
+        L = np.tril(np.asarray(L))
+        assert np.linalg.norm(L @ L.T - spd) / np.linalg.norm(spd) < 1e-13
+        assert int(info) == 0
