@@ -1,0 +1,17 @@
+#!/bin/bash
+# Tunnel-recovery watcher: probe the TPU tunnel at a low duty cycle; the
+# moment it answers, run the bench configs that still need fresh hardware
+# numbers (recorded into BENCH_LKG.json by bench.py itself).  Single user of
+# the tunnel by design — nothing else should touch it while this runs.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" 2>/dev/null; then
+    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S)); running bench"
+    BENCH_DEADLINE_SEC=5400 timeout 5700 python bench.py --only getrf,svd,heev,potrf 2>&1 | tail -2
+    echo "[tpu_watch] bench done ($(date -u +%H:%M:%S))"
+    exit 0
+  fi
+  sleep 150
+done
+echo "[tpu_watch] gave up after 200 attempts"
+exit 1
