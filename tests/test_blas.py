@@ -234,3 +234,46 @@ def test_triangular_band_norm_not_symmetrized(rng):
 def test_copy_raw_array_converts_dtype(rng):
     out = blas.copy(np.ones((2, 2)), np.zeros((2, 2), dtype=np.float32))
     assert out.dtype == jnp.float32
+
+
+def test_gemm_f64_emulation(rng):
+    """Option::f64_emulation: double-precision-class gemm on f64-less
+    hardware via exact Ozaki bf16 splitting (SURVEY §7 hard-part 6)."""
+    import slate_tpu as slate
+
+    m, k, n = 48, 100, 32
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    c = rng.standard_normal((m, n))
+    ref = 2.0 * (a @ b) - 0.5 * c
+    out = np.asarray(slate.gemm(2.0, a, b, -0.5, c.copy(),
+                                opts={"f64_emulation": True}))
+    err = np.max(np.abs(out - ref)) / np.max(np.abs(ref))
+    assert err < 1e-12, err                     # far beyond f32's ~1e-7
+    # ill-scaled rows/cols stay accurate (per-row exponent normalization)
+    a2 = a * np.logspace(-6, 6, m)[:, None]
+    ref2 = a2 @ b
+    out2 = np.asarray(slate.gemm(1.0, a2, b, 0.0, np.zeros((m, n)),
+                                 opts={"f64_emulation": True}))
+    assert np.max(np.abs(out2 - ref2)) / np.max(np.abs(ref2)) < 1e-12
+
+
+def test_gemm_f64_emulation_residual_and_complex(rng):
+    """The alpha/beta combination happens inside the compensated accumulator:
+    a residual r = Ax - b with b = A@x (f64) comes out ~1e-14 relative, where
+    a pre-collapsed f32 product would leave ~1e-8; complex runs as four real
+    products with hilo combination."""
+    from slate_tpu.ops.f64emu import gemm_f64emu
+    import jax.numpy as jnp
+
+    A = rng.standard_normal((64, 64))
+    x = rng.standard_normal((64, 4))
+    b = A @ x
+    r = np.asarray(gemm_f64emu(jnp.asarray(A), jnp.asarray(x),
+                               alpha=1.0, beta=-1.0, C=jnp.asarray(b)))
+    assert np.max(np.abs(r)) / np.max(np.abs(b)) < 1e-12
+    za = rng.standard_normal((24, 40)) + 1j * rng.standard_normal((24, 40))
+    zb = rng.standard_normal((40, 16)) + 1j * rng.standard_normal((40, 16))
+    ref = za @ zb
+    got = np.asarray(gemm_f64emu(jnp.asarray(za), jnp.asarray(zb)))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-12
