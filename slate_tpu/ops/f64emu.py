@@ -46,6 +46,20 @@ from jax import lax
 _CHUNK = 256             # 2^(24 - 16): exact f32 accumulation length
 
 
+def _exact_pow2(e, dtype):
+    """2^e as EXACT floats via exponent-field bit construction — XLA's
+    ``exp2`` is a polynomial approximation whose f32 result can miss the
+    exact power of two (observed: exp2(23.0f) = 8388612 != 2^23), which
+    would silently break the error-free scaling this module depends on.
+    ``e`` must be integer-valued and within the normal-exponent range."""
+    e = jnp.asarray(e)
+    if jnp.dtype(dtype) == jnp.dtype(jnp.float64):
+        bits = (e.astype(jnp.int64) + 1023) << 52
+        return lax.bitcast_convert_type(bits, jnp.float64)
+    bits = (e.astype(jnp.int32) + 127) << 23
+    return lax.bitcast_convert_type(bits, jnp.float32)
+
+
 def split_fixed_slices(x: jax.Array, s: int):
     """Error-free fixed-grid split: returns (slices, e_row) with
     ``x[i, :] = 2^e_row[i] · Σ_j slices[j][i, :] · 2^(-7-8j)`` and every
@@ -53,7 +67,7 @@ def split_fixed_slices(x: jax.Array, s: int):
     x = jnp.asarray(x)
     amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     e = jnp.where(amax > 0, jnp.floor(jnp.log2(amax)) + 1, 0.0)
-    u = x * jnp.exp2(-e)                 # |u| < 1 (row-normalized)
+    u = x * _exact_pow2(-e, x.dtype)     # |u| < 1 (row-normalized; exact)
     slices = []
     for _ in range(s):
         c = jnp.round(u * 128.0)         # integer in [-128, 128]... plus
@@ -112,8 +126,8 @@ def _gemm_f64emu_real(A, B, slices: int):
     Bs_t, eb = split_fixed_slices(B.T, slices)
     Bs = tuple(b.T for b in Bs_t)
     hi, lo = _gemm_f64emu_fn(m, k, n, slices)(tuple(As), Bs)
-    sc = jnp.exp2(ea.astype(jnp.float32))[:, None] * \
-        jnp.exp2(eb.astype(jnp.float32))[None, :]
+    sc = _exact_pow2(ea, jnp.float32)[:, None] * \
+        _exact_pow2(eb, jnp.float32)[None, :]
     return hi * sc, lo * sc
 
 
