@@ -201,8 +201,11 @@ def child_potrf(cpu_fallback):
     # the blocked Tiled target: XLA's fused Cholesky serializes its internal
     # panel steps and crawls at large n on TPU; the framework's right-looking
     # blocked factorization keeps the trailing updates as big MXU gemms —
-    # the reason SLATE-style blocking exists (potrf.cc:84-195)
-    opts = {"target": "tiled", "block_size": 2048}
+    # the reason SLATE-style blocking exists (potrf.cc:84-195).
+    # BENCH_POTRF_NB overrides for on-chip block-size sweeps.
+    import os as _os
+    opts = {"target": "tiled",
+            "block_size": int(_os.environ.get("BENCH_POTRF_NB", 2048))}
 
     def body(i, c, a):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
@@ -232,7 +235,12 @@ def child_getrf(cpu_fallback):
     # tunnel within the config budget, while CALU keeps the panel work as
     # sorts+gemms — the SURVEY §7 prediction that tournament pivoting is the
     # better-fit default on TPU
-    opts = {"method_lu": "calu", "block_size": 2048}
+    # BENCH_GETRF_NB / BENCH_GETRF_IB override the outer/inner blocking for
+    # on-chip sweeps (VERDICT r2 next-step #2 asks for nb in {256,512,1024})
+    import os as _os
+    opts = {"method_lu": "calu",
+            "block_size": int(_os.environ.get("BENCH_GETRF_NB", 2048)),
+            "inner_blocking": int(_os.environ.get("BENCH_GETRF_IB", 256))}
 
     def body(i, c, a):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
