@@ -10,7 +10,6 @@ Run on the virtual mesh:
 
 import numpy as np
 
-import slate_tpu as slate
 from slate_tpu.parallel import (
     ProcessGrid, dense_to_band_lower, gels_lq_distributed, hesv_distributed,
     pbsv_distributed, potrf_distributed, potri_distributed)

@@ -51,13 +51,16 @@ def _exact_pow2(e, dtype):
     ``exp2`` is a polynomial approximation whose f32 result can miss the
     exact power of two (observed: exp2(23.0f) = 8388612 != 2^23), which
     would silently break the error-free scaling this module depends on.
-    ``e`` must be integer-valued and within the normal-exponent range."""
+    ``e`` is clamped to the normal-exponent range: beyond it the true scale
+    is not a representable normal float, and an unclamped shift corrupts
+    the sign bit (rows whose magnitudes sit outside ~[2^-126, 2^127] in the
+    f32 path saturate, matching what any f32 result could express)."""
     e = jnp.asarray(e)
     if jnp.dtype(dtype) == jnp.dtype(jnp.float64):
-        bits = (e.astype(jnp.int64) + 1023) << 52
-        return lax.bitcast_convert_type(bits, jnp.float64)
-    bits = (e.astype(jnp.int32) + 127) << 23
-    return lax.bitcast_convert_type(bits, jnp.float32)
+        ec = jnp.clip(e.astype(jnp.int64), -1022, 1023)
+        return lax.bitcast_convert_type((ec + 1023) << 52, jnp.float64)
+    ec = jnp.clip(e.astype(jnp.int32), -126, 127)
+    return lax.bitcast_convert_type((ec + 127) << 23, jnp.float32)
 
 
 def split_fixed_slices(x: jax.Array, s: int):
@@ -67,6 +70,9 @@ def split_fixed_slices(x: jax.Array, s: int):
     x = jnp.asarray(x)
     amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     e = jnp.where(amax > 0, jnp.floor(jnp.log2(amax)) + 1, 0.0)
+    # keep both e and -e inside the normal range of the compute dtype
+    lim = 1000.0 if jnp.dtype(x.dtype) == jnp.dtype(jnp.float64) else 120.0
+    e = jnp.clip(e, -lim, lim)
     u = x * _exact_pow2(-e, x.dtype)     # |u| < 1 (row-normalized; exact)
     slices = []
     for _ in range(s):
@@ -126,9 +132,14 @@ def _gemm_f64emu_real(A, B, slices: int):
     Bs_t, eb = split_fixed_slices(B.T, slices)
     Bs = tuple(b.T for b in Bs_t)
     hi, lo = _gemm_f64emu_fn(m, k, n, slices)(tuple(As), Bs)
-    sc = _exact_pow2(ea, jnp.float32)[:, None] * \
-        _exact_pow2(eb, jnp.float32)[None, :]
-    return hi * sc, lo * sc
+    # scale in the widest dtype available: under x64 the exponent SUM ea+eb
+    # (up to ±2000 after clamping) still fits f64's normal range; on the
+    # f32-only target the sum clamps — saturating exactly like any f32
+    # representation of the true product would
+    sdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    esum = ea.astype(sdt)[:, None] + eb.astype(sdt)[None, :]
+    sc = _exact_pow2(esum, sdt)
+    return (hi.astype(sdt) * sc).astype(sdt), (lo.astype(sdt) * sc).astype(sdt)
 
 
 def _hilo_add(h, l, x):

@@ -49,6 +49,23 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def _band_lu_geometry(n: int, kl: int, ku: int, nb: int, nprocs: int):
+    """Window/padding geometry shared by the band-LU factor AND its solves —
+    one source of truth (round-3 review: gbtrs recomputed npad from the same
+    formula and relied on a comment to keep them in lock-step).
+
+    Returns (wr, wc, nd, npad): window rows/cols, factored-form storage
+    depth, and the padded problem size."""
+    klt = max(1, _ceil_div(kl, nb))
+    kut = max(1, _ceil_div(ku, nb))
+    wr = (klt + 1) * nb
+    wc = (klt + kut + 1) * nb
+    nd = wr + kl + ku
+    unit = nb * nprocs
+    npad = ceil_mult(max(n + wc, unit), unit)
+    return wr, wc, nd, npad
+
+
 def dense_to_band_lower(A: jax.Array, kd: int) -> jax.Array:
     """Compact lower band: Ab[j, i] = A[i+j, i], zero beyond the edge."""
     n = A.shape[-1]
@@ -320,14 +337,17 @@ def pbsv_distributed(Ab: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
 
 
 class BandLUDist(NamedTuple):
-    """Distributed band LU factored form: dense-window band storage of L\\U
-    (rows kl..kl+kl+ku of LAPACK gb convention, as compact (2kl+ku+1, n)),
-    plus per-window permutations — the window-local Pivots analogue."""
-    lub: jax.Array       # (2*kl+ku+1, n) compact: row j = diagonal j-kl-ku
+    """Distributed band LU factored form: compact factored storage (row j =
+    diagonal j - kl - ku; depth wr-1 below the diagonal for the dense-form
+    window multipliers), plus per-window permutations — the window-local
+    Pivots analogue.  ``npad`` records the padded problem size the factor
+    ran at, so the solves replay the exact same window schedule."""
+    lub: jax.Array       # (wr + kl + ku, n) compact factored form
     perms: jax.Array     # (nt, wr) window permutations
     kl: int
     ku: int
     nb: int
+    npad: int
 
 
 def dense_to_band_general(A: jax.Array, kl: int, ku: int,
@@ -450,13 +470,7 @@ def gbtrf_distributed(Gb: jax.Array, grid: ProcessGrid, kl: int, ku: int,
     n = Gb.shape[1]
     nb = max(1, min(nb, n))
     nprocs = grid.p * grid.q
-    unit = nb * nprocs
-    klt = max(1, _ceil_div(kl, nb))
-    kut = max(1, _ceil_div(ku, nb))
-    wr = (klt + 1) * nb
-    wc = (klt + kut + 1) * nb
-    nd = wr + kl + ku                        # factored-form storage depth
-    npad = ceil_mult(max(n + wc, unit), unit)
+    wr, wc, nd, npad = _band_lu_geometry(n, kl, ku, nb, nprocs)
     Gb = jnp.concatenate(
         [Gb, jnp.zeros((nd - nd_in, n), Gb.dtype)], axis=0)
     if npad > n:
@@ -473,7 +487,7 @@ def gbtrf_distributed(Gb: jax.Array, grid: ProcessGrid, kl: int, ku: int,
     diag = lub[kl + ku]
     bad = ~jnp.isfinite(diag) | (diag == 0)
     info = jnp.where(bad.any(), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
-    return BandLUDist(lub, perms, kl, ku, nb), info
+    return BandLUDist(lub, perms, kl, ku, nb, npad), info
 
 
 @lru_cache(maxsize=32)
@@ -558,19 +572,16 @@ def gbtrs_distributed(fac: BandLUDist, B: jax.Array,
                       grid: ProcessGrid) -> jax.Array:
     """Solve from the distributed band LU (src/gbtrs.cc): pivoted forward
     sweep + banded backward sweep, both windowed over the mesh."""
-    lub, perms, kl, ku, nb = fac
+    lub, perms, kl, ku, nb, npad = fac
     n = lub.shape[1]
     vec = B.ndim == 1
     B2 = B[:, None] if vec else B
     nrhs = B2.shape[1]
     nprocs = grid.p * grid.q
-    unit = nb * nprocs
-    klt = max(1, _ceil_div(kl, nb))
-    kut = max(1, _ceil_div(ku, nb))
-    wr = (klt + 1) * nb
-    wc = (klt + kut + 1) * nb
-    npad = ceil_mult(max(n + wc, unit), unit)
-    nd = wr + kl + ku                   # factored-form depth
+    wr, wc, nd, npad_geom = _band_lu_geometry(n, kl, ku, nb, nprocs)
+    slate_assert(npad == npad_geom,
+                 "band LU factor was built on a different grid size; "
+                 "re-factor on this grid")
     if npad > n:
         pad = jnp.zeros((nd, npad - n), lub.dtype)
         pad = pad.at[kl + ku, :].set(1)

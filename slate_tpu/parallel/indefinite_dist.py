@@ -44,7 +44,9 @@ from .band_dist import (BandLUDist, dense_to_band_general, gbtrf_distributed,
                         gbtrs_distributed)
 from .distribute import ceil_mult
 from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
-from .pivot import step_permutation, tournament_piv
+from .pivot import (exchange_rows as _exchange_rows,
+                    extract_rows as _extract_rows,
+                    step_permutation, tournament_piv)
 
 AX = (ROW_AXIS, COL_AXIS)
 
@@ -79,11 +81,7 @@ def _hetrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
         def extract_rows(X_loc, r0, cnt):
             """Replicated (cnt, npad) block of rows [r0, r0+cnt)."""
             S = r0 + jnp.arange(cnt, dtype=jnp.int32)
-            loc = S - ri * mr
-            own = (loc >= 0) & (loc < mr)
-            rows = X_loc[jnp.clip(loc, 0, mr - 1)]
-            rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
-            return lax.psum(rows, AX)
+            return _extract_rows(X_loc, S, ri, mr, AX)
 
         def step(j, carry):
             A_loc, L_loc, T_loc, perm = carry
@@ -159,14 +157,7 @@ def _hetrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             src = stepperm[jnp.clip(S, 0, npad - 1)]
 
             def exchange_rows(X_loc):
-                loc = src - ri * mr
-                own = (loc >= 0) & (loc < mr)
-                rows = X_loc[jnp.clip(loc, 0, mr - 1)]
-                rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
-                rows = lax.psum(rows, AX)
-                dst = S - ri * mr
-                dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)
-                return X_loc.at[dst].set(rows, mode="drop")
+                return _exchange_rows(X_loc, S, src, ri, mr, AX)
 
             # two-sided on A: rows (psum) then columns (local gather)
             A_loc = exchange_rows(A_loc)
@@ -201,14 +192,7 @@ def _hetrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             srcb = jnp.clip(j1 + blkperm, 0, npad - 1)
 
             def reorder_block_rows(X_loc):
-                loc = srcb - ri * mr
-                own = (loc >= 0) & (loc < mr)
-                rows = X_loc[jnp.clip(loc, 0, mr - 1)]
-                rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
-                rows = lax.psum(rows, AX)
-                dst = Sb - ri * mr
-                dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)
-                return X_loc.at[dst].set(rows, mode="drop")
+                return _exchange_rows(X_loc, Sb, srcb, ri, mr, AX)
 
             A_loc = reorder_block_rows(A_loc)
             A_loc = A_loc.at[:, Sb].set(A_loc[:, srcb], mode="drop")

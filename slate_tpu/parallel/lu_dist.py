@@ -49,7 +49,8 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
-from .pivot import step_permutation, tournament_piv
+from .pivot import (exchange_rows as _exchange_rows,
+                    step_permutation, tournament_piv)
 
 
 @lru_cache(maxsize=32)
@@ -86,19 +87,11 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             stepperm = step_permutation(piv, k0, npad, nb)
             perm = perm[stepperm]
 
-            # ---- apply the row permutation: only dirty rows move.
-            # new content at position s is old row stepperm[s]; dirty positions
-            # are within {k0..k0+nb-1} ∪ piv.
+            # ---- apply the row permutation: only dirty rows move
+            # (shared machinery, pivot.py); dirty positions are within
+            # {k0..k0+nb-1} ∪ piv
             S = jnp.concatenate([k0 + jnp.arange(nb, dtype=jnp.int32), piv])
-            src = stepperm[S]
-            loc = src - pi * mr
-            own = (loc >= 0) & (loc < mr)
-            rows = A_loc[jnp.clip(loc, 0, mr - 1)]
-            rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
-            rows = lax.psum(rows, ROW_AXIS)            # (2nb, mc) everywhere
-            dst = S - pi * mr
-            dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)  # mr = dropped
-            A_loc = A_loc.at[dst].set(rows, mode="drop")
+            A_loc = _exchange_rows(A_loc, S, stepperm[S], pi, mr, ROW_AXIS)
 
             # ---- panel factorization on the permuted panel
             pan = extract_panel(A_loc, k0)
@@ -219,17 +212,10 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
             stepperm = step_permutation(piv, k0, mpad, nb)
             perm = perm[stepperm]
 
-            # ---- dirty-row exchange (≤ 2nb rows move, full local width)
+            # ---- dirty-row exchange (≤ 2nb rows move, full local width;
+            # shared machinery, pivot.py)
             S = jnp.concatenate([k0 + jnp.arange(nb, dtype=jnp.int32), piv])
-            src = stepperm[S]
-            loc = src - ri * mr
-            own = (loc >= 0) & (loc < mr)
-            rows = A_loc[jnp.clip(loc, 0, mr - 1)]
-            rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
-            rows = lax.psum(rows, AX)          # (2nb, npc) everywhere
-            dst = S - ri * mr
-            dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)
-            A_loc = A_loc.at[dst].set(rows, mode="drop")
+            A_loc = _exchange_rows(A_loc, S, stepperm[S], ri, mr, AX)
 
             # ---- diagonal block factor (rows [k0,k0+nb) live on device po)
             po = k0 // mr

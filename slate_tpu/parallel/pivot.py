@@ -57,3 +57,30 @@ def step_permutation(piv, k0, npad: int, nb: int):
     iota = jnp.arange(npad, dtype=jnp.int32)
     stepperm, _ = lax.fori_loop(0, nb, swap_body, (iota, iota))
     return stepperm
+
+
+def extract_rows(X_loc, S, ri, mr: int, ax):
+    """Replicated copy of global rows ``S`` from a row-block-sharded local
+    shard: owners contribute, one masked psum replicates (the tileBcast /
+    permuteRows gather half — ONE implementation for every distributed
+    factorization, round-3 review: this idiom had four hand-rolled copies)."""
+    loc = S - ri * mr
+    own = (loc >= 0) & (loc < mr)
+    rows = X_loc[jnp.clip(loc, 0, mr - 1)]
+    rows = jnp.where(own[:, None], rows, jnp.zeros_like(rows))
+    return lax.psum(rows, ax)
+
+
+def scatter_rows(X_loc, S, rows, ri, mr: int):
+    """Write replicated ``rows`` into positions ``S``: each owner keeps its
+    slice, everyone else drops (the scatter half of the exchange)."""
+    dst = S - ri * mr
+    dst = jnp.where((dst >= 0) & (dst < mr), dst, mr)     # mr = dropped
+    return X_loc.at[dst].set(rows, mode="drop")
+
+
+def exchange_rows(X_loc, S, src, ri, mr: int, ax):
+    """Move rows ``src`` into positions ``S`` (the ≤2nb dirty-row exchange:
+    one gather psum + one owner scatter)."""
+    return scatter_rows(X_loc, S, extract_rows(X_loc, src, ri, mr, ax),
+                        ri, mr)

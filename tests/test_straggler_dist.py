@@ -4,7 +4,6 @@ symmetric-indefinite Aasen (src/hetrf.cc:642, hetrs/hesv), and inversion
 (src/trtri.cc, src/trtrm.cc, src/potri.cc, src/getri.cc:242)."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
