@@ -221,3 +221,33 @@ class TestLQDist:
         full = np.tril(Ainv) + np.tril(Ainv, -1).T
         ref = np.linalg.inv(spd)
         assert np.linalg.norm(full - ref) / np.linalg.norm(ref) < 1e-11
+
+
+class TestComplexDist:
+    """z-family coverage of the round-3 distributed paths (the conj_t /
+    cplx handling was written in but previously unpinned)."""
+
+    def test_complex_hesv(self, grid24, rng):
+        n, nb = 96, 8
+        H = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        H = (H + H.conj().T) / 2
+        B = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+        X, info = hesv_distributed(jnp.asarray(H), jnp.asarray(B), grid24,
+                                   nb=nb)
+        assert np.linalg.norm(H @ np.asarray(X) - B) / np.linalg.norm(B) \
+            < 1e-11
+        assert int(info) == 0
+
+    def test_complex_pbsv(self, grid24, rng):
+        n, kd, nb = 96, 5, 8
+        A = np.zeros((n, n), complex)
+        for j in range(1, kd + 1):
+            v = rng.standard_normal(n - j) + 1j * rng.standard_normal(n - j)
+            A += np.diag(v, j) + np.diag(v.conj(), -j)
+        A += np.diag(np.abs(rng.standard_normal(n)) + 6 * kd)
+        Ab = dense_to_band_lower(jnp.asarray(np.tril(A)), kd)
+        B = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+        X, info = pbsv_distributed(Ab, jnp.asarray(B), grid24, kd, nb=nb)
+        assert np.linalg.norm(A @ np.asarray(X) - B) / np.linalg.norm(B) \
+            < 1e-12
+        assert int(info) == 0
