@@ -30,7 +30,9 @@ from .qr_dist import (tsqr_distributed, unmqr_distributed, gels_qr_distributed,
                       gelqf_distributed, unmlq_distributed,
                       gels_lq_distributed)
 from .eig_dist import (heev_distributed, hegv_distributed, svd_distributed,
-                       norm_distributed, col_norms_distributed)
+                       norm_distributed, col_norms_distributed,
+                       he2hb_distributed, ge2tb_distributed,
+                       unmtr_he2hb_distributed)
 from .inverse import (trtri_distributed, trtrm_distributed, potri_distributed,
                       getri_distributed)
 from .band_dist import (pbtrf_distributed, pbtrs_distributed, pbsv_distributed,

@@ -251,3 +251,27 @@ class TestComplexDist:
         assert np.linalg.norm(A @ np.asarray(X) - B) / np.linalg.norm(B) \
             < 1e-12
         assert int(info) == 0
+
+    def test_complex_tslu_lq_he2hb(self, grid24, rng):
+        from slate_tpu.parallel import (gelqf_distributed,
+                                        getrf_tall_distributed,
+                                        he2hb_distributed)
+        m, n = 256, 64
+        a = rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+        LU, perm, info = getrf_tall_distributed(jnp.asarray(a), grid24, nb=16)
+        L = jnp.tril(LU, -1)[:, :n] + jnp.eye(m, n, dtype=LU.dtype)
+        U = jnp.triu(LU[:n, :])
+        err = float(jnp.linalg.norm(a[np.asarray(perm)] - L @ U)
+                    / jnp.linalg.norm(a))
+        assert err < 1e-12 and int(info) == 0
+        w = rng.standard_normal((40, 120)) + 1j * rng.standard_normal((40, 120))
+        Lq, Q = gelqf_distributed(jnp.asarray(w), grid24, nb=16)
+        assert float(jnp.linalg.norm(Lq @ Q - w) / jnp.linalg.norm(w)) < 1e-13
+        assert float(jnp.linalg.norm(
+            Q @ jnp.conj(Q).T - jnp.eye(40))) < 1e-12
+        H = rng.standard_normal((64, 64)) + 1j * rng.standard_normal((64, 64))
+        H = (H + H.conj().T) / 2
+        band, Vs, Ts = he2hb_distributed(jnp.asarray(H), grid24, nb=8)
+        lam_d = np.sort(np.linalg.eigvalsh(np.asarray(band)))
+        lam_s = np.sort(np.linalg.eigvalsh(np.asarray(H)))
+        assert np.max(np.abs(lam_d - lam_s)) < 1e-12
