@@ -301,3 +301,40 @@ class TestCondestDist:
         rc = float(pocondest_distributed(L, anorm, grid24))
         true_rc = 1.0 / (anorm * np.linalg.norm(np.linalg.inv(spd), 1))
         assert 0.05 * true_rc < rc < 20 * true_rc
+
+
+class TestEdgeShapes:
+    """Degenerate-geometry pins: 1x1 grid, single-panel nb=n, tiny n across 8
+    devices, full-bandwidth band, near-square tall, kl=0 band."""
+
+    def test_edges(self, grid24, rng):
+        import jax
+        from slate_tpu.parallel import getrf_tall_distributed
+
+        g11 = ProcessGrid(1, 1, devices=jax.devices()[:1])
+        B = rng.standard_normal((40, 2))
+        H = rng.standard_normal((40, 40))
+        H = (H + H.T) / 2
+        X, info = hesv_distributed(jnp.asarray(H), jnp.asarray(B), g11, nb=8)
+        assert np.linalg.norm(H @ np.asarray(X) - B) / np.linalg.norm(B) < 1e-11
+        X2, _ = hesv_distributed(jnp.asarray(H), jnp.asarray(B), grid24, nb=40)
+        assert np.linalg.norm(H @ np.asarray(X2) - B) / np.linalg.norm(B) < 1e-11
+        H3 = rng.standard_normal((8, 8))
+        H3 = (H3 + H3.T) / 2
+        B3 = rng.standard_normal((8, 1))
+        X3, _ = hesv_distributed(jnp.asarray(H3), jnp.asarray(B3), grid24, nb=4)
+        assert np.linalg.norm(H3 @ np.asarray(X3) - B3) / np.linalg.norm(B3) < 1e-11
+        A = H @ H.T + 80 * np.eye(40)
+        Ab = dense_to_band_lower(jnp.asarray(np.tril(A)), 39)
+        Xb, _ = pbsv_distributed(Ab, jnp.asarray(B), grid24, 39, nb=8)
+        assert np.linalg.norm(A @ np.asarray(Xb) - B) / np.linalg.norm(B) < 1e-12
+        a = rng.standard_normal((41, 40))
+        LU, perm, info = getrf_tall_distributed(jnp.asarray(a), grid24, nb=8)
+        L = jnp.tril(LU, -1)[:, :40] + jnp.eye(41, 40)
+        U = jnp.triu(LU[:40, :])
+        assert float(jnp.linalg.norm(a[np.asarray(perm)] - L @ U)
+                     / jnp.linalg.norm(a)) < 1e-12
+        G = np.triu(np.tril(rng.standard_normal((40, 40)), 2)) + 10 * np.eye(40)
+        Gb = dense_to_band_general(jnp.asarray(G), 0, 2, extra=0)
+        Xg, _ = gbsv_distributed(Gb, jnp.asarray(B), grid24, 0, 2, nb=8)
+        assert np.linalg.norm(G @ np.asarray(Xg) - B) / np.linalg.norm(B) < 1e-12
