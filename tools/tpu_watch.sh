@@ -1,17 +1,29 @@
 #!/bin/bash
 # Tunnel-recovery watcher: probe the TPU tunnel at a low duty cycle; the
-# moment it answers, run the bench configs that still need fresh hardware
-# numbers (recorded into BENCH_LKG.json by bench.py itself).  Single user of
-# the tunnel by design — nothing else should touch it while this runs.
+# moment it answers, (1) capture the outstanding bench configs into
+# BENCH_LKG.json, then (2) run the VERDICT-requested block-size sweeps for
+# getrf/potrf, logging each child's JSON line.  Single tunnel user by design.
 cd "$(dirname "$0")/.."
-for i in $(seq 1 200); do
+for i in $(seq 1 400); do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" 2>/dev/null; then
-    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S)); running bench"
+    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S)); bench"
     BENCH_DEADLINE_SEC=5400 timeout 5700 python bench.py --only getrf,svd,heev,potrf 2>&1 | tail -2
-    echo "[tpu_watch] bench done ($(date -u +%H:%M:%S))"
+    echo "[tpu_watch] main bench done ($(date -u +%H:%M:%S)); sweeps"
+    for cfg in "2048 512" "1024 256" "2048 128"; do
+      set -- $cfg
+      echo "[sweep] getrf nb=$1 ib=$2"
+      BENCH_GETRF_NB=$1 BENCH_GETRF_IB=$2 timeout 1500 \
+        python bench.py --child getrf 2>&1 | tail -1
+    done
+    for nb in 1024 4096; do
+      echo "[sweep] potrf nb=$nb"
+      BENCH_POTRF_NB=$nb timeout 1200 \
+        python bench.py --child potrf 2>&1 | tail -1
+    done
+    echo "[tpu_watch] all done ($(date -u +%H:%M:%S))"
     exit 0
   fi
   sleep 150
 done
-echo "[tpu_watch] gave up after 200 attempts"
+echo "[tpu_watch] gave up after 400 attempts"
 exit 1
