@@ -371,9 +371,37 @@ def pbtrs(L, B, opts=None, kd=None):
 
 def pbsv(A, B, opts=None, uplo=None, kd=None):
     """Solve SPD band system (src/pbsv.cc): pbtrf + pbtrs. Returns (X, info)."""
-    L, info = pbtrf(A, opts, uplo, kd)
+    from ..core.matrix import distribution_grid
+
+    grid = distribution_grid(A, B)
+    slate_assert(isinstance(A, BaseBandMatrix) or kd is not None,
+                 "pbsv on a raw array needs kd=")
     kd_v = (getattr(A, "kd", max(A.kl, A.ku)) if isinstance(A, BaseBandMatrix)
             else int(kd))
+    if grid is not None:
+        # wrapper bound to a >1-device grid: the compact-storage windowed
+        # factorization over the mesh (pbsv.cc consumes the construction-time
+        # distribution the same way); the factor writes back dense so the
+        # in-place contract matches the local path (a later pbtrs on the
+        # wrapper sees L, not A)
+        from ..parallel.band_dist import (band_lower_to_dense,
+                                          dense_to_band_lower,
+                                          pbtrf_distributed,
+                                          pbtrs_distributed)
+
+        opts_ = Options.make(opts)
+        a = as_array(A)
+        u = (A.uplo if isinstance(A, BaseBandMatrix)
+             else Uplo.from_string(uplo or "lower"))
+        if u == Uplo.Upper:
+            a = jnp.conj(jnp.swapaxes(a, -1, -2))
+        Ab = dense_to_band_lower(a, kd_v)
+        Lb, info = pbtrf_distributed(Ab, grid, kd_v, nb=opts_.block_size)
+        write_back(A, band_lower_to_dense(Lb, a.shape[-1]))
+        x = pbtrs_distributed(Lb, as_array(B), grid, kd_v,
+                              nb=opts_.block_size)
+        return write_back(B, x), info
+    L, info = pbtrf(A, opts, uplo, kd)
     x = pbtrs(as_array(L), B, opts, kd=kd_v)
     return x, info
 
@@ -498,6 +526,31 @@ def gbtrs(fac: BandLU, B, opts=None):
 def gbsv(A, B, opts=None, kl=None, ku=None):
     """Solve a general band system (src/gbsv.cc): gbtrf + gbtrs.
     Returns (X, info)."""
+    from ..core.matrix import distribution_grid
+
+    grid = distribution_grid(A, B)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: compact-storage windowed band LU
+        # over the mesh.  The factored band writes back dense (the in-place
+        # contract); note the window pivots live in the distributed factored
+        # form — callers needing repeated solves should use
+        # parallel.gbtrf_distributed / gbtrs_distributed directly.
+        from ..parallel.band_dist import (band_general_to_dense,
+                                          dense_to_band_general,
+                                          gbtrf_distributed,
+                                          gbtrs_distributed)
+
+        opts_ = Options.make(opts)
+        a, kl_v, ku_v = _band_meta(A, kl, ku)
+        Gb = dense_to_band_general(a, kl_v, ku_v, extra=kl_v)
+        fac, info = gbtrf_distributed(Gb, grid, kl_v, ku_v,
+                                      nb=opts_.block_size)
+        nd = fac.lub.shape[0]
+        wr = nd - kl_v - ku_v
+        write_back(A, band_general_to_dense(fac.lub, a.shape[-1],
+                                            wr - 1, ku_v, extra=kl_v))
+        x = gbtrs_distributed(fac, as_array(B), grid)
+        return write_back(B, x), info
     fac, info = gbtrf(A, opts, kl, ku)
     x = gbtrs(fac, B, opts)
     return x, info

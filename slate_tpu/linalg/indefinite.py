@@ -174,6 +174,19 @@ def hetrs(fac: HermitianFactors, B, opts=None):
 def hesv(A, B, opts=None, uplo=None):
     """Solve a Hermitian-indefinite system (src/hesv.cc): hetrf + hetrs.
     Returns (X, info)."""
+    from ..core.matrix import distribution_grid
+
+    grid = distribution_grid(A, B)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: distributed CA-Aasen
+        # (hesv.cc consumes the construction-time distribution the same way)
+        from ..parallel import hesv_distributed
+
+        opts_ = Options.make(opts)
+        a = _full_herm(A, uplo)
+        x, info = hesv_distributed(a, as_array(B), grid,
+                                   nb=min(opts_.block_size, a.shape[-1]))
+        return write_back(B, x), info
     fac, info = hetrf(A, opts, uplo)
     x = hetrs(fac, B, opts)
     return x, info

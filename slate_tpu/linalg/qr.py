@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.exceptions import SlateError
-from ..core.matrix import BaseMatrix, as_array, write_back
+from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodGels, Op, Options, Side
 from ..utils.trace import trace_block
 from .chol import _chol_info
@@ -349,6 +349,26 @@ def gels(A, BX, opts=None):
     a = as_array(A)
     b = as_array(BX)
     m, n = a.shape[-2:]
+    grid = distribution_grid(A, BX)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: ride the mesh least-squares
+        # pipelines (gels.cc consumes the construction-time distribution the
+        # same way).  An explicit MethodGels is honored; Auto takes the same
+        # CholQR-when-very-tall heuristic as the local path.
+        from ..parallel import (gels_caqr_distributed, gels_cholqr_distributed,
+                                gels_lq_distributed)
+
+        if m < n:
+            X = gels_lq_distributed(a, b, grid, nb=opts.block_size)
+        else:
+            gmethod = opts.method_gels
+            if gmethod == MethodGels.Auto:
+                gmethod = MethodGels.CholQR if m >= 4 * n else MethodGels.QR
+            if gmethod == MethodGels.CholQR:
+                X = gels_cholqr_distributed(a, b, grid)
+            else:
+                X = gels_caqr_distributed(a, b, grid, nb=opts.block_size)
+        return write_back(BX, X) if X.shape == b.shape else X
     method = opts.method_gels
     if method == MethodGels.Auto:
         # cholqr for very tall well-shaped panels (the reference's heuristic picks
