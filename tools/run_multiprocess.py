@@ -148,6 +148,40 @@ def worker(coord: str, pid: int) -> None:
             blk, href[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-3)
     print(f"worker {pid}: herk OK", flush=True)
 
+    # --- 6) round-3 stragglers across the process boundary: compact-band
+    # Cholesky and CA-Aasen (their window psums / tournament all-gathers ride
+    # the same flattened mesh axis pair)
+    from slate_tpu.parallel import (dense_to_band_lower, hesv_distributed,
+                                    pbsv_distributed)
+
+    kd = 3
+    Abd = np.zeros((m, m), np.float32)
+    for j in range(1, kd + 1):
+        v = rng.standard_normal(m - j).astype(np.float32)
+        Abd += np.diag(v, j) + np.diag(v, -j)
+    Abd += np.diag(np.abs(rng.standard_normal(m)).astype(np.float32)
+                   + 4 * kd)
+    Ab = dense_to_band_lower(jnp.asarray(np.tril(Abd)), kd)
+    Xb, infob = pbsv_distributed(Ab, jnp.asarray(Bh), grid, kd, nb=8)
+    Xbref = np.linalg.solve(Abd, Bh)
+    for shard in Xb.addressable_shards:
+        r0, c0 = (sl.start or 0 for sl in shard.index)
+        blk = np.asarray(shard.data)
+        np.testing.assert_allclose(
+            blk, Xbref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-3)
+    print(f"worker {pid}: pbsv OK", flush=True)
+
+    Hm = rng.standard_normal((m, m)).astype(np.float32)
+    Hm = (Hm + Hm.T) / 2
+    Xh, infoh = hesv_distributed(jnp.asarray(Hm), jnp.asarray(Bh), grid, nb=8)
+    Xhref = np.linalg.solve(Hm, Bh)
+    for shard in Xh.addressable_shards:
+        r0, c0 = (sl.start or 0 for sl in shard.index)
+        blk = np.asarray(shard.data)
+        np.testing.assert_allclose(
+            blk, Xhref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-2)
+    print(f"worker {pid}: hesv OK", flush=True)
+
     jax.distributed.shutdown()
     print(f"worker {pid}: OK", flush=True)
 
