@@ -40,7 +40,7 @@ from . import matgen
 from . import native
 from .utils import debug, load_matrix, print_matrix, save_matrix, trace
 from .matgen import generate_matrix
-from .ops.f64emu import gemm_f64emu, gesv_f64ir
+from .ops.f64emu import gemm_f64emu, gesv_f64ir, posv_f64ir
 from . import lapack_api
 from . import scalapack_api
 

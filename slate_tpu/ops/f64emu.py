@@ -270,3 +270,47 @@ def gesv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
         Xh, t = _two_sum(Xh, D)
         Xl = Xl + t
     return (Xh[:, 0], Xl[:, 0], iters) if vec else (Xh, Xl, iters)
+
+
+def posv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
+    """SPD sibling of ``gesv_f64ir`` (the posv_mixed counterpart): f32
+    Cholesky factor + f64-emulated-residual refinement.  Same double-f32
+    iterate and convergence policy; returns ``(Xh, Xl, iterations)``."""
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    vec = B.ndim == 1
+    B2 = B[:, None] if vec else B
+    Af = A.astype(jnp.float32)
+    L = lax.linalg.cholesky(Af)
+
+    def solve32(R):
+        y = lax.linalg.triangular_solve(L, R, left_side=True, lower=True)
+        return lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                           conjugate_a=True, transpose_a=True)
+
+    b_hi = B2.astype(jnp.float32)
+    Xh = solve32(b_hi)
+    Xl = jnp.zeros_like(Xh)
+    eps32 = float(jnp.finfo(jnp.float32).eps)
+    bnorm = float(jnp.max(jnp.abs(b_hi))) or 1.0
+    anorm = float(jnp.max(jnp.abs(Af)))
+    xnorm = float(jnp.max(jnp.abs(Xh))) or 1.0
+    tol = tol_factor * (eps32 ** 2) * max(bnorm, anorm * xnorm)
+    iters = 0
+    prev_rmax = float("inf")
+    for it in range(max_iterations):
+        rh, rl = gemm_f64emu(A, Xh.astype(A.dtype), alpha=-1.0, beta=1.0,
+                             C=B2, return_hilo=True)
+        rh2, rl2 = gemm_f64emu(A, Xl.astype(A.dtype), alpha=-1.0,
+                               return_hilo=True)
+        rh, t = _two_sum(rh, rh2)
+        rl = rl + rl2 + t
+        iters = it + 1
+        rmax = float(jnp.max(jnp.abs(rh + rl)))
+        if rmax <= tol or rmax > 0.9 * prev_rmax:
+            break
+        prev_rmax = rmax
+        D = solve32((rh + rl).astype(jnp.float32))
+        Xh, t = _two_sum(Xh, D)
+        Xl = Xl + t
+    return (Xh[:, 0], Xl[:, 0], iters) if vec else (Xh, Xl, iters)

@@ -301,3 +301,19 @@ def test_gesv_f64ir_double_class_solve(rng):
         np.linalg.solve(A.astype(np.float32), B.astype(np.float32))
         .astype(np.float64) - Xtrue) / np.linalg.norm(Xtrue)
     assert err < 1e-3 * f32err          # orders beyond the native solve
+
+
+def test_posv_f64ir_double_class_solve(rng):
+    """SPD sibling of gesv_f64ir: f32 Cholesky + emulated-f64 refinement."""
+    from slate_tpu.ops.f64emu import posv_f64ir
+    import jax.numpy as jnp
+
+    n = 100
+    g = rng.standard_normal((n, n))
+    A = g @ g.T + n * np.eye(n)
+    Xt = rng.standard_normal((n, 2))
+    B = A @ Xt
+    Xh, Xl, iters = posv_f64ir(jnp.asarray(A), jnp.asarray(B))
+    X = np.asarray(Xh, np.float64) + np.asarray(Xl, np.float64)
+    assert np.linalg.norm(X - Xt) / np.linalg.norm(Xt) < 1e-11
+    assert 1 <= iters <= 10
