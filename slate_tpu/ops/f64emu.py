@@ -184,10 +184,13 @@ def gemm_f64emu(A, B, alpha=1.0, beta=0.0, C=None, slices: int = 7,
         cdt = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
         prod_h = reh.astype(cdt) + 1j * imh.astype(cdt)
         prod_l = rel.astype(cdt) + 1j * iml.astype(cdt)
-        out = alpha * (prod_h + prod_l)
+        prod_h, prod_l = prod_h * alpha, prod_l * alpha
         if C is not None and beta != 0:
-            out = out + beta * jnp.asarray(C).astype(cdt)
-        return out
+            prod_h, prod_l = _hilo_add(prod_h, prod_l,
+                                       beta * jnp.asarray(C).astype(cdt))
+        if return_hilo:
+            return prod_h, prod_l
+        return prod_h + prod_l
     hi, lo = _gemm_f64emu_real(A, B, slices)
     af = jnp.float32(alpha)
     hi, lo = hi * af, lo * af            # exact for signed powers of two
@@ -208,92 +211,20 @@ def gemm_f64emu(A, B, alpha=1.0, beta=0.0, C=None, slices: int = 7,
     return hi.astype(out_dt) + lo.astype(out_dt)
 
 
-def gesv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
-    """Solve A X = B to double-precision-class accuracy on f32 hardware:
-    f32 LU factor + iterative refinement whose residuals run through the
-    exact-splitting gemm — SURVEY §7's "bf16/f32 factor, f64-emulated
-    refine" made concrete (the reference's gesv_mixed with the refinement
-    precision EMULATED instead of assumed in hardware).
-
-    The iterate is carried as a double-f32 (Xh, Xl) pair; each round
-    computes R = B - A·(Xh + Xl) inside the compensated accumulator (both
-    halves through ``gemm_f64emu``'s hilo path), solves the f32 correction
-    against the cached LU, and folds it in error-free.  Standard IR theory
-    then gives forward error ~ eps_emu · cond(A), i.e. ~1e-13-class
-    solutions for well-conditioned systems — on hardware whose native
-    solve stops at ~1e-6.
-
-    Returns ``(Xh, Xl, iterations)``: the solution is ``Xh + Xl`` evaluated
-    in f64 (or consumed as a pair on f64-less backends).
-    """
-    A = jnp.asarray(A)
-    B = jnp.asarray(B)
-    vec = B.ndim == 1
-    B2 = B[:, None] if vec else B
-    Af = A.astype(jnp.float32)
-    plu, _, perm = lax.linalg.lu(Af)
-
-    def solve32(R):
-        pb = jnp.take(R, perm, axis=0)
-        y = lax.linalg.triangular_solve(plu, pb, left_side=True, lower=True,
-                                        unit_diagonal=True)
-        return lax.linalg.triangular_solve(plu, y, left_side=True,
-                                           lower=False)
-
-    b_hi = B2.astype(jnp.float32)       # first iterate; gemm_f64emu folds
-    Xh = solve32(b_hi)                  # B's full (split) precision via C
+def _f64ir_refine(A, B2, Xh, solve32, max_iterations: int,
+                  tol_factor: float):
+    """Shared refinement core of gesv_f64ir / posv_f64ir: double-f32 iterate,
+    residuals through the compensated gemm, stagnation-aware stop.  Returns
+    (Xh, Xl, iters, info): info = 1 when the f32 factor produced non-finite
+    values (singular / not SPD) — the LAPACK-style signal the *_mixed
+    drivers carry — in which case the loop never runs."""
     Xl = jnp.zeros_like(Xh)
+    if not bool(jnp.all(jnp.isfinite(Xh))):
+        return Xh, Xl, 0, 1
     eps32 = float(jnp.finfo(jnp.float32).eps)
+    b_hi = B2.astype(Xh.dtype)
     bnorm = float(jnp.max(jnp.abs(b_hi))) or 1.0
-    anorm = float(jnp.max(jnp.abs(Af)))
-    xnorm = float(jnp.max(jnp.abs(Xh))) or 1.0
-    # converged when the residual reaches the emulated-precision floor, OR
-    # when it stagnates (the floor in practice sits a small multiple above
-    # eps32^2 * scale; stagnation is the robust detector)
-    tol = tol_factor * (eps32 ** 2) * max(bnorm, anorm * xnorm)
-    iters = 0
-    prev_rmax = float("inf")
-    for it in range(max_iterations):
-        # R = B - A Xh - A Xl, all inside the compensated accumulator
-        rh, rl = gemm_f64emu(A, Xh.astype(A.dtype), alpha=-1.0, beta=1.0,
-                             C=B2, return_hilo=True)
-        rh2, rl2 = gemm_f64emu(A, Xl.astype(A.dtype), alpha=-1.0,
-                               return_hilo=True)
-        rh, t = _two_sum(rh, rh2)
-        rl = rl + rl2 + t                   # b's lo half folds via C
-        iters = it + 1
-        rmax = float(jnp.max(jnp.abs(rh + rl)))
-        if rmax <= tol or rmax > 0.9 * prev_rmax:
-            break                        # converged or stagnated at the floor
-        prev_rmax = rmax
-        D = solve32((rh + rl).astype(jnp.float32))
-        Xh, t = _two_sum(Xh, D)
-        Xl = Xl + t
-    return (Xh[:, 0], Xl[:, 0], iters) if vec else (Xh, Xl, iters)
-
-
-def posv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
-    """SPD sibling of ``gesv_f64ir`` (the posv_mixed counterpart): f32
-    Cholesky factor + f64-emulated-residual refinement.  Same double-f32
-    iterate and convergence policy; returns ``(Xh, Xl, iterations)``."""
-    A = jnp.asarray(A)
-    B = jnp.asarray(B)
-    vec = B.ndim == 1
-    B2 = B[:, None] if vec else B
-    Af = A.astype(jnp.float32)
-    L = lax.linalg.cholesky(Af)
-
-    def solve32(R):
-        y = lax.linalg.triangular_solve(L, R, left_side=True, lower=True)
-        return lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
-                                           conjugate_a=True, transpose_a=True)
-
-    b_hi = B2.astype(jnp.float32)
-    Xh = solve32(b_hi)
-    Xl = jnp.zeros_like(Xh)
-    eps32 = float(jnp.finfo(jnp.float32).eps)
-    bnorm = float(jnp.max(jnp.abs(b_hi))) or 1.0
-    anorm = float(jnp.max(jnp.abs(Af)))
+    anorm = float(jnp.max(jnp.abs(A)))
     xnorm = float(jnp.max(jnp.abs(Xh))) or 1.0
     tol = tol_factor * (eps32 ** 2) * max(bnorm, anorm * xnorm)
     iters = 0
@@ -310,7 +241,75 @@ def posv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
         if rmax <= tol or rmax > 0.9 * prev_rmax:
             break
         prev_rmax = rmax
-        D = solve32((rh + rl).astype(jnp.float32))
+        D = solve32((rh + rl).astype(Xh.dtype))
         Xh, t = _two_sum(Xh, D)
         Xl = Xl + t
-    return (Xh[:, 0], Xl[:, 0], iters) if vec else (Xh, Xl, iters)
+    return Xh, Xl, iters, 0
+
+
+def gesv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
+    """Solve A X = B to double-precision-class accuracy on f32 hardware:
+    f32 LU factor + iterative refinement whose residuals run through the
+    exact-splitting gemm — SURVEY §7's "bf16/f32 factor, f64-emulated
+    refine" made concrete (the reference's gesv_mixed with the refinement
+    precision EMULATED instead of assumed in hardware).
+
+    The iterate is carried as a double-f32 (Xh, Xl) pair; each round
+    computes R = B - A·(Xh + Xl) inside the compensated accumulator (both
+    halves through ``gemm_f64emu``'s hilo path), solves the f32 correction
+    against the cached LU, and folds it in error-free.  Standard IR theory
+    then gives forward error ~ eps_emu · cond(A), i.e. ~1e-13-class
+    solutions for well-conditioned systems — on hardware whose native
+    solve stops at ~1e-6.
+
+    Returns ``(Xh, Xl, iterations, info)``: the solution is ``Xh + Xl``
+    evaluated in f64 (or consumed as a pair on f64-less backends); info = 1
+    means the f32 factor was singular (non-finite) and no refinement ran.
+    Complex inputs factor in c64 and refine through the four-real-products
+    gemm path.
+    """
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    vec = B.ndim == 1
+    B2 = B[:, None] if vec else B
+    lo_dt = jnp.complex64 if jnp.iscomplexobj(A) else jnp.float32
+    Af = A.astype(lo_dt)
+    plu, _, perm = lax.linalg.lu(Af)
+
+    def solve32(R):
+        pb = jnp.take(R, perm, axis=0)
+        y = lax.linalg.triangular_solve(plu, pb, left_side=True, lower=True,
+                                        unit_diagonal=True)
+        return lax.linalg.triangular_solve(plu, y, left_side=True,
+                                           lower=False)
+
+    Xh = solve32(B2.astype(lo_dt))
+    Xh, Xl, iters, info = _f64ir_refine(A, B2, Xh, solve32, max_iterations,
+                                        tol_factor)
+    return ((Xh[:, 0], Xl[:, 0], iters, info) if vec
+            else (Xh, Xl, iters, info))
+
+
+def posv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):
+    """SPD/HPD sibling of ``gesv_f64ir`` (the posv_mixed counterpart): f32
+    Cholesky factor + f64-emulated-residual refinement.  Same double-f32
+    iterate and convergence policy; returns ``(Xh, Xl, iterations, info)``
+    with info = 1 when A is not (numerically) positive definite."""
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    vec = B.ndim == 1
+    B2 = B[:, None] if vec else B
+    lo_dt = jnp.complex64 if jnp.iscomplexobj(A) else jnp.float32
+    Af = A.astype(lo_dt)
+    L = lax.linalg.cholesky(Af)
+
+    def solve32(R):
+        y = lax.linalg.triangular_solve(L, R, left_side=True, lower=True)
+        return lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                           conjugate_a=True, transpose_a=True)
+
+    Xh = solve32(B2.astype(lo_dt))
+    Xh, Xl, iters, info = _f64ir_refine(A, B2, Xh, solve32, max_iterations,
+                                        tol_factor)
+    return ((Xh[:, 0], Xl[:, 0], iters, info) if vec
+            else (Xh, Xl, iters, info))

@@ -292,11 +292,11 @@ def test_gesv_f64ir_double_class_solve(rng):
     A = (U * np.logspace(0, -3, n)) @ V.T       # cond ~ 1e3
     Xtrue = rng.standard_normal((n, 2))
     B = A @ Xtrue
-    Xh, Xl, iters = gesv_f64ir(jnp.asarray(A), jnp.asarray(B))
+    Xh, Xl, iters, info = gesv_f64ir(jnp.asarray(A), jnp.asarray(B))
     X = np.asarray(Xh, np.float64) + np.asarray(Xl, np.float64)
     err = np.linalg.norm(X - Xtrue) / np.linalg.norm(Xtrue)
     assert err < 1e-10, err
-    assert 1 <= iters <= 10
+    assert 1 <= iters <= 10 and info == 0
     f32err = np.linalg.norm(
         np.linalg.solve(A.astype(np.float32), B.astype(np.float32))
         .astype(np.float64) - Xtrue) / np.linalg.norm(Xtrue)
@@ -313,7 +313,21 @@ def test_posv_f64ir_double_class_solve(rng):
     A = g @ g.T + n * np.eye(n)
     Xt = rng.standard_normal((n, 2))
     B = A @ Xt
-    Xh, Xl, iters = posv_f64ir(jnp.asarray(A), jnp.asarray(B))
+    Xh, Xl, iters, info = posv_f64ir(jnp.asarray(A), jnp.asarray(B))
     X = np.asarray(Xh, np.float64) + np.asarray(Xl, np.float64)
     assert np.linalg.norm(X - Xt) / np.linalg.norm(Xt) < 1e-11
-    assert 1 <= iters <= 10
+    assert 1 <= iters <= 10 and info == 0
+    # non-SPD input signals info = 1 without burning refinement rounds
+    Abad = A.copy()
+    Abad[0, 0] = -Abad[0, 0]
+    _, _, it_bad, info_bad = posv_f64ir(jnp.asarray(Abad), jnp.asarray(B))
+    assert info_bad == 1 and it_bad == 0
+    # complex HPD refines through the four-real-products gemm path
+    gz = rng.standard_normal((40, 40)) + 1j * rng.standard_normal((40, 40))
+    Az = gz @ gz.conj().T + 40 * np.eye(40)
+    Xz = rng.standard_normal((40, 2)) + 1j * rng.standard_normal((40, 2))
+    Bz = Az @ Xz
+    Zh, Zl, _, iz = posv_f64ir(jnp.asarray(Az), jnp.asarray(Bz))
+    Z = np.asarray(Zh, np.complex128) + np.asarray(Zl, np.complex128)
+    assert iz == 0
+    assert np.linalg.norm(Z - Xz) / np.linalg.norm(Xz) < 1e-10
