@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from .mesh import ProcessGrid
 from .solvers import trsm_distributed
