@@ -70,6 +70,19 @@ BASELINES = {
                        # n=4096; same scaling rationale)
     "norm": 450.0,     # dlange Fro n=16384: bandwidth-bound, ~1.8 TB/s HBM
                        # at 8 B/elem and 2 flops/elem -> ~450 GFLOP/s
+    "potrf_la": 13000.0,  # same job/denominator as potrf: the lookahead-
+                          # pipelined schedule vs the unrolled tiled one
+    "f64gemm": 15000.0,   # A100 cuBLAS dgemm n=4096 — TRUE fp64-class vs
+                          # fp64 (the one apples-to-apples ratio; every other
+                          # config crosses f32-HIGHEST vs fp64, BENCH_NOTES)
+    "gesvir": 9000.0,     # A100 dgesv n=4096-class (dgetrf-rate bound);
+                          # ours = f32 LU + emulated-f64 IR to double-class
+                          # forward error (gesv_f64ir), flops on the 2n^3/3
+                          # dgetrf model
+    "heev2s": 225.0,      # dsyevd values n=8192 published-order estimate
+                          # (between the n=4096 150 and n=16384 300 rates);
+                          # config exists to time the SLATE-parity two-stage
+                          # pipeline next to the fused QDWH default
 }
 
 # ordered safest-first: a child killed mid-execution can wedge the
@@ -77,13 +90,15 @@ BASELINES = {
 # cheap/robust on hardware run before the risky ones (LU last: both the fused
 # and tournament paths are slow enough at n=16384 to risk the per-config
 # timeout)
-CONFIGS = ["gemm", "norm", "potrf", "gels", "heev", "svd", "getrf"]
+CONFIGS = ["gemm", "norm", "f64gemm", "potrf", "potrf_la", "gels", "gesvir",
+           "heev", "svd", "getrf", "heev2s"]
 HEADLINE = "gemm"
 
 # per-config child timeouts: the BASELINE-scale eig/SVD configs and the
 # 64-panel two-level CALU carry minutes of (remote) XLA compile before the
 # first timed call — measured 3 min of compile for the getrf program on CPU
-CONFIG_TIMEOUTS = {"heev": 1300, "svd": 1500, "getrf": 1500}
+CONFIG_TIMEOUTS = {"heev": 1300, "svd": 1500, "getrf": 1500,
+                   "potrf_la": 1300, "heev2s": 1800}
 
 # ---------------------------------------------------------------------------
 # children — each runs in its own process, imports jax lazily
@@ -374,6 +389,156 @@ def child_norm(cpu_fallback):
                    "over 1/4 iter time"})
 
 
+def _direct_rate(run, make_input, fetch, flops, repeats=3):
+    """GFLOP/s for drivers that are not chain-able (multi-call pipelines /
+    internal while_loops): warm once, then time ``run`` on a freshly
+    perturbed input each repeat, forcing with a one-element fetch.  The
+    ~70 ms tunnel dispatch overhead is included, so rates are honest
+    under-estimates for second-scale jobs."""
+    fetch(run(make_input(0)))          # compile + warm
+    ts = []
+    for j in range(repeats):
+        x = make_input(j + 1)
+        fetch(x)                       # materialize before the clock
+        t0 = time.perf_counter()
+        fetch(run(x))
+        ts.append(time.perf_counter() - t0)
+    sec = min(ts)
+    return flops / sec / 1e9, sec
+
+
+def child_potrf_la(cpu_fallback):
+    """potrf through the explicit lookahead pipeline (parallel/pipeline.py,
+    potrf.cc:136-177's overlap structure) on a 1-device grid — the
+    single-chip analogue of potrf_distributed(lookahead>=2).  Same job and
+    denominator as the 'potrf' config, so the two rows read as a direct
+    schedule comparison (VERDICT r3 #2)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 2048 if cpu_fallback else 16384
+    key = jax.random.PRNGKey(0)
+    m = jax.random.normal(key, (n, n), dtype=jnp.float32) / jnp.sqrt(
+        jnp.asarray(n, jnp.float32))
+    a = jnp.matmul(m, m.T, precision=lax.Precision.HIGHEST) + 2.0 * jnp.eye(
+        n, dtype=jnp.float32)
+
+    from slate_tpu.parallel.mesh import ProcessGrid
+    from slate_tpu.parallel.pipeline import potrf_pipelined
+
+    import os as _os
+    nb = int(_os.environ.get("BENCH_POTRF_LA_NB", 2048))
+    grid = ProcessGrid(1, 1)
+
+    def make_input(j):
+        return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
+
+    gflops, sec = _direct_rate(
+        lambda x: potrf_pipelined(x, grid, nb=nb),
+        make_input, lambda r: float(r.ravel()[0]), n**3 / 3.0,
+        repeats=2)
+    _emit({"metric": f"potrf_lookahead_f32_n{n}_gflops",
+           "value": round(gflops, 1), "unit": "GFLOP/s", "n": n, "nb": nb,
+           "sec_per_call": sec})
+
+
+def child_f64gemm(cpu_fallback):
+    """Emulated-f64 gemm n=4096 (ops/f64emu.py: exact Ozaki bf16 splitting,
+    ~s(s+1)/2 = 28 MXU passes at s=7).  The one config whose vs_baseline is
+    fp64-class against fp64 (A100 dgemm) with no precision crossing — the
+    d-precision story VERDICT r3 #3 asked to measure, not just claim."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024 if cpu_fallback else 4096
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), dtype=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(n, jnp.float32))
+
+    from slate_tpu.ops.f64emu import gemm_f64emu
+
+    def body(i, c, b, scale):
+        # the full job each iteration: split both operands, 28 bf16 passes,
+        # hilo accumulate, collapse (alpha folds in exactly: power of two
+        # only when n is a power of 4; the rounding is one f32 multiply)
+        return gemm_f64emu(c, b, alpha=scale)
+
+    ks, kl = (1, 3) if cpu_fallback else (2, 8)
+    gflops, per_iter = _chain_rate(body, a, (b, scale), ks, kl, 2.0 * n**3,
+                                   repeats=2)
+    _emit({"metric": f"gemm_f64emu_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter,
+           "note": "double-precision-class result (Ozaki s=7); honest fp64 "
+                   "vs fp64 ratio"})
+
+
+def child_gesvir(cpu_fallback):
+    """gesv_f64ir n=4096: f32 LU factor + emulated-f64 iterative refinement
+    to double-class forward error (ops/f64emu.py; the reference's dsgesv
+    with the f64 refinement EMULATED).  Rate on the dgetrf 2n^3/3 model +
+    the thin IR solves, vs A100 dgesv."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024 if cpu_fallback else 4096
+    nrhs = 16
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32) + 2.0 * jnp.sqrt(
+        jnp.asarray(n, jnp.float32)) * jnp.eye(n, dtype=jnp.float32)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (n, nrhs),
+                          dtype=jnp.float32)
+
+    from slate_tpu.ops.f64emu import gesv_f64ir
+
+    def run(x):
+        Xh, Xl, iters, info = gesv_f64ir(x, b)
+        return Xh
+
+    def make_input(j):
+        return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
+
+    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
+    gflops, sec = _direct_rate(run, make_input,
+                               lambda r: float(r.ravel()[0]), flops,
+                               repeats=2)
+    _emit({"metric": f"gesv_f64ir_n{n}_gflops", "value": round(gflops, 1),
+           "unit": "GFLOP/s", "n": n, "nrhs": nrhs, "sec_per_call": sec,
+           "note": "double-class forward error on f32 hardware; one host "
+                   "sync per solve (lax.while_loop IR)"})
+
+
+def child_heev2s(cpu_fallback):
+    """heev values via the SLATE-parity two-stage pipeline (he2hb -> hb2st ->
+    Sturm/D&C, linalg/eig.py method='two_stage') at n=8192 — timed next to
+    the fused-QDWH default so the method choice is data, not stance
+    (VERDICT r3 #4)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 512 if cpu_fallback else 8192
+    key = jax.random.PRNGKey(0)
+    m = jax.random.normal(key, (n, n), dtype=jnp.float32)
+    a = (m + m.T) / 2.0
+
+    import slate_tpu
+
+    def run(x):
+        lam, _ = slate_tpu.heev(x, want_vectors=False, method="two_stage")
+        return lam
+
+    def make_input(j):
+        return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
+
+    gflops, sec = _direct_rate(run, make_input,
+                               lambda r: float(r.ravel()[0]),
+                               4.0 * n**3 / 3.0, repeats=2)
+    _emit({"metric": f"heev_two_stage_f32_n{n}_gflops",
+           "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
+           "sec_per_call": sec})
+
+
 CHILDREN = {
     "probe": lambda cpu: child_probe(),
     "norm": child_norm,
@@ -383,6 +548,10 @@ CHILDREN = {
     "gels": child_gels,
     "heev": child_heev,
     "svd": child_svd,
+    "potrf_la": child_potrf_la,
+    "f64gemm": child_f64gemm,
+    "gesvir": child_gesvir,
+    "heev2s": child_heev2s,
 }
 
 
@@ -562,9 +731,17 @@ def main(only=None):
             # the heev/svd configs were re-scaled this round) so readers do
             # not compare incomparable ratios
             if c.get("baseline") is not None \
-                    and c.get("baseline") != BASELINES.get(name):
+                    and c.get("baseline") != BASELINES.get(name) \
+                    and isinstance(c.get("value"), (int, float)):
+                # RENORMALIZE to the current denominator — the reported
+                # ratio must be the honest current reading, the recorded
+                # one is side info (VERDICT r3 weak-#2: a flag alone let
+                # the stale 1.131 read as the headline while current=0.57)
+                summary[name]["vs_baseline"] = round(
+                    c["value"] / BASELINES[name], 3)
                 summary[name]["baseline_changed"] = {
                     "recorded": c.get("baseline"),
+                    "recorded_ratio": c.get("vs_baseline"),
                     "current": BASELINES.get(name)}
             if res.get("ok"):   # CPU-fallback number, kept as side info
                 summary[name]["cpu_fallback_value"] = res.get("value")

@@ -194,6 +194,28 @@ def gemm_f64emu(A, B, alpha=1.0, beta=0.0, C=None, slices: int = 7,
     hi, lo = _gemm_f64emu_real(A, B, slices)
     af = jnp.float32(alpha)
     hi, lo = hi * af, lo * af            # exact for signed powers of two
+    if C is not None and beta != 0 and jnp.iscomplexobj(C):
+        # real A·B with a complex C: the product contributes only to the real
+        # part, but C's imaginary part must survive (previously it was
+        # silently discarded by the f32 cast).  Fold beta·Re(C) into the real
+        # accumulator and carry beta·Im(C) as its own split pair.
+        Cf = jnp.asarray(C)
+        bf = jnp.float32(beta)
+        cr_hi = jnp.real(Cf).astype(jnp.float32)
+        hi, lo = _hilo_add(hi, lo, bf * cr_hi)
+        ci_hi = jnp.imag(Cf).astype(jnp.float32)
+        im_h, im_l = bf * ci_hi, jnp.zeros_like(ci_hi)
+        if Cf.dtype == jnp.dtype(jnp.complex128):
+            cr = jnp.real(Cf)
+            ci = jnp.imag(Cf)
+            lo = lo + bf * (cr - cr_hi.astype(cr.dtype)).astype(jnp.float32)
+            im_l = im_l + bf * (ci - ci_hi.astype(ci.dtype)).astype(jnp.float32)
+        cdt = jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+        prod_h = hi.astype(cdt) + 1j * im_h.astype(cdt)
+        prod_l = lo.astype(cdt) + 1j * im_l.astype(cdt)
+        if return_hilo:
+            return prod_h, prod_l
+        return prod_h + prod_l
     if C is not None and beta != 0:
         # fold C in as its own double-f32 split, so an f64 C (CPU testing /
         # a caller-carried hilo pair collapsed to f64) loses nothing; an f32
@@ -217,34 +239,52 @@ def _f64ir_refine(A, B2, Xh, solve32, max_iterations: int,
     residuals through the compensated gemm, stagnation-aware stop.  Returns
     (Xh, Xl, iters, info): info = 1 when the f32 factor produced non-finite
     values (singular / not SPD) — the LAPACK-style signal the *_mixed
-    drivers carry — in which case the loop never runs."""
+    drivers carry — in which case the loop never runs.
+
+    Device-side throughout: the convergence test rides a ``lax.while_loop``
+    carry, so the whole solve is jittable and costs ONE host sync at the
+    caller's read-out — on the TPU tunnel (~70 ms round-trip) the previous
+    per-round ``float()`` checks dominated the solve itself."""
     Xl = jnp.zeros_like(Xh)
-    if not bool(jnp.all(jnp.isfinite(Xh))):
-        return Xh, Xl, 0, 1
+    finite = jnp.all(jnp.isfinite(Xh))
     eps32 = float(jnp.finfo(jnp.float32).eps)
+    rdt = jnp.zeros((), Xh.dtype).real.dtype
     b_hi = B2.astype(Xh.dtype)
-    bnorm = float(jnp.max(jnp.abs(b_hi))) or 1.0
-    anorm = float(jnp.max(jnp.abs(A)))
-    xnorm = float(jnp.max(jnp.abs(Xh))) or 1.0
-    tol = tol_factor * (eps32 ** 2) * max(bnorm, anorm * xnorm)
-    iters = 0
-    prev_rmax = float("inf")
-    for it in range(max_iterations):
+    bnorm = jnp.max(jnp.abs(b_hi))
+    bnorm = jnp.where(bnorm == 0, 1.0, bnorm).astype(rdt)
+    anorm = jnp.max(jnp.abs(A)).astype(rdt)
+    xnorm = jnp.max(jnp.abs(Xh))
+    xnorm = jnp.where(xnorm == 0, 1.0, xnorm).astype(rdt)
+    tol = tol_factor * (eps32 ** 2) * jnp.maximum(bnorm, anorm * xnorm)
+
+    def cond(c):
+        _, _, _, it, stop = c
+        return (~stop) & (it < max_iterations)
+
+    def body(c):
+        Xh, Xl, prev, it, _ = c
         rh, rl = gemm_f64emu(A, Xh.astype(A.dtype), alpha=-1.0, beta=1.0,
                              C=B2, return_hilo=True)
         rh2, rl2 = gemm_f64emu(A, Xl.astype(A.dtype), alpha=-1.0,
                                return_hilo=True)
         rh, t = _two_sum(rh, rh2)
         rl = rl + rl2 + t
-        iters = it + 1
-        rmax = float(jnp.max(jnp.abs(rh + rl)))
-        if rmax <= tol or rmax > 0.9 * prev_rmax:
-            break
-        prev_rmax = rmax
-        D = solve32((rh + rl).astype(Xh.dtype))
-        Xh, t = _two_sum(Xh, D)
-        Xl = Xl + t
-    return Xh, Xl, iters, 0
+        rfull = rh + rl
+        rmax = jnp.max(jnp.abs(rfull)).astype(rdt)
+        stop = (rmax <= tol) | (rmax > 0.9 * prev)
+
+        def refine(_):
+            D = solve32(rfull.astype(Xh.dtype))
+            Xh2, tt = _two_sum(Xh, D)
+            return Xh2, Xl + tt
+
+        Xh3, Xl3 = lax.cond(stop, lambda _: (Xh, Xl), refine, None)
+        return Xh3, Xl3, rmax, it + 1, stop
+
+    init = (Xh, Xl, jnp.asarray(jnp.inf, rdt), jnp.int32(0), ~finite)
+    Xh, Xl, _, iters, _ = lax.while_loop(cond, body, init)
+    info = jnp.where(finite, 0, 1).astype(jnp.int32)
+    return Xh, Xl, iters, info
 
 
 def gesv_f64ir(A, B, max_iterations: int = 20, tol_factor: float = 4.0):

@@ -1,14 +1,25 @@
 #!/bin/bash
 # Tunnel-recovery watcher: probe the TPU tunnel at a low duty cycle; the
-# moment it answers, (1) capture the outstanding bench configs into
-# BENCH_LKG.json, then (2) run the VERDICT-requested block-size sweeps for
-# getrf/potrf, logging each child's JSON line.  Single tunnel user by design.
+# moment it answers, capture the outstanding bench configs into
+# BENCH_LKG.json in VERDICT-r3 priority order, then the block-size sweeps.
+# Single tunnel user by design.  Each bench.py invocation is a separate
+# parent (fresh probe) so one wedged child cannot strand the later groups.
 cd "$(dirname "$0")/.."
 for i in $(seq 1 400); do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" 2>/dev/null; then
-    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S)); bench"
-    BENCH_DEADLINE_SEC=5400 timeout 5700 python bench.py --only getrf,svd,heev,potrf 2>&1 | tail -2
-    echo "[tpu_watch] main bench done ($(date -u +%H:%M:%S)); sweeps"
+    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S))"
+    # (a) the two-rounds-overdue getrf two-level CALU number
+    BENCH_DEADLINE_SEC=1800 timeout 2000 python bench.py --only getrf 2>&1 | tail -1
+    echo "[tpu_watch] getrf done ($(date -u +%H:%M:%S))"
+    # (b) heev/svd at the BASELINE-scale configs
+    BENCH_DEADLINE_SEC=3000 timeout 3200 python bench.py --only heev,svd 2>&1 | tail -1
+    echo "[tpu_watch] heev/svd done ($(date -u +%H:%M:%S))"
+    # (c) the round-4 additions: lookahead potrf, f64 story, two-stage timing
+    BENCH_DEADLINE_SEC=4200 timeout 4500 python bench.py --only potrf_la,f64gemm,gesvir,heev2s 2>&1 | tail -1
+    echo "[tpu_watch] r4 configs done ($(date -u +%H:%M:%S))"
+    # (d) refresh the five round-3 captures
+    BENCH_DEADLINE_SEC=2400 timeout 2700 python bench.py --only gemm,norm,potrf,gels 2>&1 | tail -1
+    echo "[tpu_watch] refresh done ($(date -u +%H:%M:%S)); sweeps"
     for cfg in "2048 512" "1024 256" "2048 128"; do
       set -- $cfg
       echo "[sweep] getrf nb=$1 ib=$2"
@@ -19,6 +30,11 @@ for i in $(seq 1 400); do
       echo "[sweep] potrf nb=$nb"
       BENCH_POTRF_NB=$nb timeout 1200 \
         python bench.py --child potrf 2>&1 | tail -1
+    done
+    for nb in 1024 4096; do
+      echo "[sweep] potrf_la nb=$nb"
+      BENCH_POTRF_LA_NB=$nb timeout 1200 \
+        python bench.py --child potrf_la 2>&1 | tail -1
     done
     echo "[tpu_watch] all done ($(date -u +%H:%M:%S))"
     exit 0
