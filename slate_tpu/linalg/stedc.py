@@ -52,6 +52,60 @@ _BASE_N = 32       # below this, one fused eigh is faster than a merge
 _BISECT_ITERS = 90  # geometric descent to tiny roots + full mantissa refinement
 
 
+def _secular_f(d, z2, rho, pole, off):
+    """f(lam_j = pole_j + off_j) for a (chunk of) brackets — pole-relative
+    evaluation, fused: the (m, chunk) denominator is built inside the
+    reduction as (d_i - pole_j) - off_j — the two-term form keeps the laed4
+    relative precision of the gap (pole subtracted exactly first), while XLA
+    fuses broadcast→divide→reduce so no m×m buffer survives a sweep (the
+    round-2 version cached Dlo/Dup/D_sel: 3 m² arrays that made the n=20,000
+    merge memory-infeasible).  The single shared implementation keeps prep
+    and bisection evaluating f identically at the same point."""
+    den = (d[:, None] - pole[None, :]) - off[None, :]
+    return 1.0 + rho * jnp.sum(z2[:, None] / den, axis=0)
+
+
+def _secular_prep(d: jax.Array, z2: jax.Array, rho: jax.Array):
+    """Per-bracket setup of the secular solve: bracket widths and closer-pole
+    selection (one f sweep).  Separated from the bisection loop so the
+    distributed path can shard the loop over brackets (parallel/secular.py).
+    Returns (pole, sigma, gaps, use_lower)."""
+    eps = jnp.finfo(d.dtype).eps
+    width = rho * jnp.sum(z2) + eps * (jnp.abs(d[-1]) + 1)
+    gaps = jnp.concatenate([d[1:] - d[:-1], width[None]])
+    d_up = jnp.concatenate([d[1:], (d[-1] + width)[None]])  # upper pole per bracket
+
+    # closer-pole selection: f increasing per bracket; f(mid) >= 0 -> root in
+    # the lower half (solve in u = lam - d_j), else upper (u = d_{j+1} - lam)
+    use_lower = _secular_f(d, z2, rho, d, 0.5 * gaps) >= 0
+    sigma = jnp.where(use_lower, 1.0, -1.0).astype(d.dtype)
+    pole = jnp.where(use_lower, d, d_up)
+    return pole, sigma, gaps, use_lower
+
+
+def _secular_bisect(d, z2, rho, pole, sigma, gaps, use_lower):
+    """The O(m_chunk · m · iters) bisection loop for a (chunk of) brackets,
+    given the full pole set (d, z2 — replicated) and per-bracket prep.
+    Pure elementwise-over-brackets: the distributed path maps it over
+    bracket shards with no collectives (parallel/secular.py)."""
+    def body(_, lohi):
+        lo, hi = lohi
+        u = 0.5 * (lo + hi)
+        f = _secular_f(d, z2, rho, pole, sigma * u)
+        bigger = sigma * f < 0               # root at larger u
+        lo = jnp.where(bigger, u, lo)
+        hi = jnp.where(bigger, hi, u)
+        return lo, hi
+
+    z0 = jnp.zeros(pole.shape, d.dtype)
+    lo, hi = lax.fori_loop(0, _BISECT_ITERS, body, (z0, 0.5 * gaps))
+    u = 0.5 * (lo + hi)
+    t = jnp.where(use_lower, u, gaps - u)
+    s = jnp.where(use_lower, gaps - u, u)
+    lam = pole + sigma * u
+    return t, s, lam
+
+
 def _secular_roots(d: jax.Array, z2: jax.Array, rho: jax.Array):
     """All m roots of 1 + rho * sum_i z2_i / (d_i - lam) = 0 (stedc_secular /
     laed4 analogue), vectorized over brackets (d_j, d_{j+1}).
@@ -63,45 +117,8 @@ def _secular_roots(d: jax.Array, z2: jax.Array, rho: jax.Array):
     (t, s, lam): t = lam - d_j and s = d_{j+1} - lam, both accurate near their
     respective poles.
     """
-    m = d.shape[0]
-    znorm2 = jnp.sum(z2)
-    eps = jnp.finfo(d.dtype).eps
-    width = rho * znorm2 + eps * (jnp.abs(d[-1]) + 1)
-    gaps = jnp.concatenate([d[1:] - d[:-1], width[None]])
-    d_up = jnp.concatenate([d[1:], (d[-1] + width)[None]])  # upper pole per bracket
-
-    # pole-relative evaluation, fused: the (m, m) denominator is built inside
-    # the reduction as (d_i - pole_j) - off_j — the two-term form keeps the
-    # laed4 relative precision of the gap (pole subtracted exactly first),
-    # while XLA fuses broadcast→divide→reduce so no m×m buffer survives a
-    # sweep (the round-2 version cached Dlo/Dup/D_sel: 3 m² arrays that made
-    # the n=20,000 merge memory-infeasible)
-    def f_at(pole, off):     # f(lam_j = pole_j + off_j) for all brackets j
-        den = (d[:, None] - pole[None, :]) - off[None, :]
-        return 1.0 + rho * jnp.sum(z2[:, None] / den, axis=0)
-
-    # closer-pole selection: f increasing per bracket; f(mid) >= 0 -> root in
-    # the lower half (solve in u = lam - d_j), else upper (u = d_{j+1} - lam)
-    use_lower = f_at(d, 0.5 * gaps) >= 0
-    sigma = jnp.where(use_lower, 1.0, -1.0).astype(d.dtype)
-    pole = jnp.where(use_lower, d, d_up)
-
-    def body(_, lohi):
-        lo, hi = lohi
-        u = 0.5 * (lo + hi)
-        f = f_at(pole, sigma * u)
-        bigger = sigma * f < 0               # root at larger u
-        lo = jnp.where(bigger, u, lo)
-        hi = jnp.where(bigger, hi, u)
-        return lo, hi
-
-    z0 = jnp.zeros((m,), d.dtype)
-    lo, hi = lax.fori_loop(0, _BISECT_ITERS, body, (z0, 0.5 * gaps))
-    u = 0.5 * (lo + hi)
-    t = jnp.where(use_lower, u, gaps - u)
-    s = jnp.where(use_lower, gaps - u, u)
-    lam = jnp.where(use_lower, d + u, d_up - u)
-    return t, s, lam
+    pole, sigma, gaps, use_lower = _secular_prep(d, z2, rho)
+    return _secular_bisect(d, z2, rho, pole, sigma, gaps, use_lower)
 
 
 def _deflate(d_sorted, z_sorted, rho):
@@ -131,9 +148,10 @@ def _merge(d1, Q1, d2, Q2, rho_raw, grid=None):
 
     With ``grid`` (a ProcessGrid), the two basis-update gemms — the O(m³)
     flops of the merge — run sharded over the mesh (src/stedc_merge.cc keeps
-    Q distributed the same way); the secular solve and Loewner build are
-    O(m²·iters) and stay replicated, like the reference's per-rank secular
-    loop."""
+    Q distributed the same way), and the secular bisection — the O(m²·iters)
+    stage — shards over brackets (parallel/secular.py; the reference splits
+    the same loop across ranks, src/stedc_secular.cc).  Only the O(m²)
+    Loewner build stays replicated."""
     dt = d1.dtype
     n1 = d1.shape[0]
     n2 = d2.shape[0]
@@ -147,7 +165,12 @@ def _merge(d1, Q1, d2, Q2, rho_raw, grid=None):
     z = z[order]
     d, z2, scale, eps = _deflate(d, z, rho)
 
-    t, s, lam = _secular_roots(d, z2, rho)
+    if grid is not None:
+        from ..parallel.secular import secular_roots_sharded
+
+        t, s, lam = secular_roots_sharded(d, z2, rho, grid)
+    else:
+        t, s, lam = _secular_roots(d, z2, rho)
 
     # Gu's corrected |z~_i|^2 = prod_j (lam_j - d_i) / prod_{j != i} (d_j - d_i)
     M = lam[None, :] - d[:, None]                     # (i, j): lam_j - d_i
@@ -248,9 +271,10 @@ def _stedc_rec(d, e, grid=None) -> Tuple[jax.Array, jax.Array]:
     lam1, Z1 = _stedc_rec(d1, e[: mid - 1], grid)
     lam2, Z2 = _stedc_rec(d2, e[mid:], grid)
     if grid is not None and n >= _DIST_MERGE_MIN:
-        # eager composition: the O(m³) gemms inside are themselves jitted
-        # sharded programs; the replicated secular/Loewner stages are single
-        # fused lax ops either way
+        # eager composition: the O(m³) gemms and the O(m²·iters) secular
+        # bisection inside are themselves jitted sharded programs
+        # (parallel/summa, parallel/secular); only the O(m²) Loewner build
+        # runs as replicated fused lax ops
         return _merge(lam1, Z1, lam2, Z2, rho, grid)
     return _merge_jit(lam1, Z1, lam2, Z2, rho)
 

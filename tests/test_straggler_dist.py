@@ -338,3 +338,122 @@ class TestEdgeShapes:
         Gb = dense_to_band_general(jnp.asarray(G), 0, 2, extra=0)
         Xg, _ = gbsv_distributed(Gb, jnp.asarray(B), grid24, 0, 2, nb=8)
         assert np.linalg.norm(G @ np.asarray(Xg) - B) / np.linalg.norm(B) < 1e-12
+
+
+class TestStragglersSharding:
+    """VERDICT r3 #7: the round-3 distributed paths get the same compiled-HLO
+    proof as stage 1 (TestStage1Sharding) — per-device bytes/flops fractions
+    and the designed collectives, at n >= 1024."""
+
+    @staticmethod
+    def _grids():
+        import jax
+        return ProcessGrid(2, 4), ProcessGrid(1, 1, devices=jax.devices()[:1])
+
+    def test_tslu_per_device_resources(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from slate_tpu.parallel.lu_dist import _getrf_tall_fn
+        from slate_tpu.parallel.mesh import ROW_AXIS, COL_AXIS
+
+        m, n, nb = 2048, 256, 64
+        a = jnp.asarray(np.random.default_rng(0).standard_normal((m, n)),
+                        jnp.float32)
+        g8, g1 = self._grids()
+        spec = P((ROW_AXIS, COL_AXIS), None)
+        a8 = jax.device_put(a, NamedSharding(g8.mesh, spec))
+        a1 = jax.device_put(a, NamedSharding(g1.mesh, spec))
+        c8 = _getrf_tall_fn(g8.mesh, m, n, nb, "float32").lower(a8).compile()
+        c1 = _getrf_tall_fn(g1.mesh, m, n, nb, "float32").lower(a1).compile()
+        # rows block-sharded: each device holds 1/8 of the tall operand
+        assert c8.memory_analysis().argument_size_in_bytes == m * n * 4 // 8
+        f8 = c8.cost_analysis().get("flops", 0.0)
+        f1 = c1.cost_analysis().get("flops", 0.0)
+        assert f8 < 0.2 * f1, (f8, f1)   # measured 0.128 ~ the ideal 1/8
+        hlo = c8.as_text()
+        assert hlo.count("all-gather") >= 1   # tournament candidate gather
+        assert hlo.count("all-reduce") >= 2   # diag bcast + U row band psums
+
+    def test_pbtrf_sharded_storage_one_psum(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from slate_tpu.parallel.band_dist import _pbtrf_dist_fn, _ceil_div
+        from slate_tpu.parallel.distribute import ceil_mult
+        from slate_tpu.parallel.mesh import ROW_AXIS, COL_AXIS
+
+        n, kd, nb = 2048, 128, 64
+        kdt = max(1, _ceil_div(kd, nb))
+        w = (kdt + 1) * nb
+        npad = ceil_mult(max(n + w, nb * 8), nb * 8)
+        ab = jnp.asarray(
+            np.random.default_rng(1).standard_normal((kd + 1, npad)),
+            jnp.float32)
+        g8, g1 = self._grids()
+        spec = P(None, (ROW_AXIS, COL_AXIS))
+        a8 = jax.device_put(ab, NamedSharding(g8.mesh, spec))
+        a1 = jax.device_put(ab, NamedSharding(g1.mesh, spec))
+        c8 = _pbtrf_dist_fn(g8.mesh, npad, kd, nb, "float32").lower(
+            a8).compile()
+        c1 = _pbtrf_dist_fn(g1.mesh, npad, kd, nb, "float32").lower(
+            a1).compile()
+        # the POINT of the compact path: band storage is column-sharded, so
+        # per-device bytes are 1/8 of the (kd+1, n) band — O((kd+1)n/P)
+        assert c8.memory_analysis().argument_size_in_bytes == \
+            (kd + 1) * npad * 4 // 8
+        # windows ride exactly one masked psum in the loop body
+        assert c8.as_text().count("all-reduce") == 1
+        # window *work* is replicated by design (the window pipeline is the
+        # sequential critical path, like the reference's per-rank panel); the
+        # compiled module must still not EXCEED the single-device work
+        f8 = c8.cost_analysis().get("flops", 0.0)
+        f1 = c1.cost_analysis().get("flops", 0.0)
+        assert f8 <= 1.05 * f1, (f8, f1)  # measured 0.83
+
+    def test_hetrf_per_device_resources(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from slate_tpu.parallel.indefinite_dist import _hetrf_dist_fn
+        from slate_tpu.parallel.mesh import ROW_AXIS, COL_AXIS
+
+        n, nb = 1024, 64
+        m = np.random.default_rng(2).standard_normal((n, n))
+        a = jnp.asarray((m + m.T) / 2, jnp.float32)
+        g8, g1 = self._grids()
+        spec = P((ROW_AXIS, COL_AXIS), None)
+        a8 = jax.device_put(a, NamedSharding(g8.mesh, spec))
+        a1 = jax.device_put(a, NamedSharding(g1.mesh, spec))
+        c8 = _hetrf_dist_fn(g8.mesh, n, nb, "float32").lower(a8).compile()
+        c1 = _hetrf_dist_fn(g1.mesh, n, nb, "float32").lower(a1).compile()
+        assert c8.memory_analysis().argument_size_in_bytes == n * n * 4 // 8
+        f8 = c8.cost_analysis().get("flops", 0.0)
+        f1 = c1.cost_analysis().get("flops", 0.0)
+        assert f8 < 0.25 * f1, (f8, f1)   # measured 0.157 (tournament panels
+                                          # partially replicated, ideal 1/8)
+        hlo = c8.as_text()
+        assert hlo.count("all-gather") >= 1   # Aasen tournament gather
+        assert hlo.count("all-reduce") >= 1   # panel/T psums
+
+    def test_inverse_trsm_sharded_args(self):
+        """The inversion family (trtri/potri/getri/condest) rides the sharded
+        TriangularSolve: its compiled form must consume 1/8-sharded operands
+        and partition via all-gathers (GSPMD reports no flop counts for the
+        fused solve, so bytes + collectives are the pin)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from slate_tpu.parallel.solvers import _trsm_dist_fn
+        from slate_tpu.parallel.mesh import ROW_AXIS, COL_AXIS
+
+        n = 1024
+        L = jnp.tril(jnp.asarray(
+            np.random.default_rng(3).standard_normal((n, n)), jnp.float32)) \
+            + 4 * jnp.eye(n, dtype=jnp.float32)
+        E = jnp.eye(n, dtype=jnp.float32)
+        g8, _ = self._grids()
+        spec = NamedSharding(g8.mesh, P(ROW_AXIS, COL_AXIS))
+        L8 = jax.device_put(L, spec)
+        E8 = jax.device_put(E, spec)
+        c8 = _trsm_dist_fn(g8.mesh, True, False, "float32").lower(
+            L8, E8).compile()
+        assert c8.memory_analysis().argument_size_in_bytes == \
+            2 * n * n * 4 // 8
+        assert c8.as_text().count("all-gather") >= 1
