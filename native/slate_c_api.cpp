@@ -35,6 +35,7 @@ const char* kPrelude =
     "import numpy as np\n"
     "import slate_tpu\n"
     "import slate_tpu.scalapack_api as sk\n"
+    "_DT = dict(s=np.float32, d=np.float64, c=np.complex64, z=np.complex128)\n"
     "_handles = {}\n"          // matrix-object registry (handle API)
     "_next_handle = [1]\n";
 
@@ -221,6 +222,70 @@ int slate_sgemm(char transa, char transb, int64_t m, int64_t n, int64_t k,
                    beta, C, ldc, 4, "s");
 }
 
+// complex gemm: alpha/beta cross as pointers to one interleaved element
+static int gemm_cz_impl(char dtc, char transa, char transb, int64_t m,
+                        int64_t n, int64_t k, const void* alpha,
+                        const void* A, int64_t lda, const void* B,
+                        int64_t ldb, const void* beta, void* C, int64_t ldc,
+                        int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  double ar, ai, br, bi;
+  if (esz == 16) {
+    const double* ap = static_cast<const double*>(alpha);
+    const double* bp = static_cast<const double*>(beta);
+    ar = ap[0]; ai = ap[1]; br = bp[0]; bi = bp[1];
+  } else {
+    const float* ap = static_cast<const float*>(alpha);
+    const float* bp = static_cast<const float*>(beta);
+    ar = ap[0]; ai = ap[1]; br = bp[0]; bi = bp[1];
+  }
+  int64_t acols = (transa == 'n' || transa == 'N') ? k : m;
+  int64_t bcols = (transb == 'n' || transb == 'N') ? n : k;
+  set_mem(c.locals, "Abuf", const_cast<void*>(A), lda * acols * esz);
+  set_mem(c.locals, "Bbuf", const_cast<void*>(B), ldb * bcols * esz);
+  set_mem(c.locals, "Cbuf", C, ldc * n * esz);
+  set_chr(c.locals, "ta", transa);
+  set_chr(c.locals, "tb", transb);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "k", k);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_int(c.locals, "ldc", ldc);
+  set_dbl(c.locals, "ar", ar);
+  set_dbl(c.locals, "ai", ai);
+  set_dbl(c.locals, "br", br);
+  set_dbl(c.locals, "bi", bi);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = _DT[dtc]\n"
+      "alpha = dt(complex(ar, ai)); beta = dt(complex(br, bi))\n"
+      "arr = (m, k) if ta.lower() == 'n' else (k, m)\n"
+      "brr = (k, n) if tb.lower() == 'n' else (n, k)\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:arr[0], :arr[1]]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:brr[0], :brr[1]]\n"
+      "cm = np.frombuffer(Cbuf, dt).reshape((ldc, -1), order='F')[:m, :n]\n"
+      "fn = getattr(sk, 'p' + dtc + 'gemm')\n"
+      "cm[...] = fn(ta, tb, alpha, a, b, beta, cm.copy())\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_zgemm(char transa, char transb, int64_t m, int64_t n, int64_t k,
+                const void* alpha, const void* A, int64_t lda, const void* B,
+                int64_t ldb, const void* beta, void* C, int64_t ldc) {
+  return gemm_cz_impl('z', transa, transb, m, n, k, alpha, A, lda, B, ldb,
+                      beta, C, ldc, 16);
+}
+
+int slate_cgemm(char transa, char transb, int64_t m, int64_t n, int64_t k,
+                const void* alpha, const void* A, int64_t lda, const void* B,
+                int64_t ldb, const void* beta, void* C, int64_t ldc) {
+  return gemm_cz_impl('c', transa, transb, m, n, k, alpha, A, lda, B, ldb,
+                      beta, C, ldc, 8);
+}
+
 // ---------------------------------------------------------------------------
 
 static int gesv_impl(const char* pre, int64_t n, int64_t nrhs, void* A,
@@ -237,18 +302,28 @@ static int gesv_impl(const char* pre, int64_t n, int64_t nrhs, void* A,
   set_int(c.locals, "ldb", ldb);
   set_chr(c.locals, "dtc", pre[0]);
   return run_code(
-      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "dt = _DT[dtc]\n"
       "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
       "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
       "pv = np.frombuffer(Pbuf, np.int64)[:n]\n"
-      "fac = sk.pdgetrf if dtc == 'd' else sk.psgetrf\n"
-      "slv = sk.pdgetrs if dtc == 'd' else sk.psgetrs\n"
+      "fac = getattr(sk, 'p' + dtc + 'getrf')\n"
+      "slv = getattr(sk, 'p' + dtc + 'getrs')\n"
       "lu, piv, info = fac(a.copy())\n"
       "a[...] = lu\n"
       "pv[...] = np.asarray(piv, np.int64)\n"
       "if info == 0:\n"
       "    b[...] = slv('n', lu, piv, b.copy())\n",
       c.locals);
+}
+
+int slate_zgesv(int64_t n, int64_t nrhs, void* A, int64_t lda, int64_t* ipiv,
+                void* B, int64_t ldb) {
+  return gesv_impl("z", n, nrhs, A, lda, ipiv, B, ldb, 16);
+}
+
+int slate_cgesv(int64_t n, int64_t nrhs, void* A, int64_t lda, int64_t* ipiv,
+                void* B, int64_t ldb) {
+  return gesv_impl("c", n, nrhs, A, lda, ipiv, B, ldb, 8);
 }
 
 int slate_dgesv(int64_t n, int64_t nrhs, double* A, int64_t lda, int64_t* ipiv,
@@ -277,10 +352,10 @@ static int posv_impl(const char* pre, char uplo, int64_t n, int64_t nrhs,
   set_int(c.locals, "ldb", ldb);
   set_chr(c.locals, "dtc", pre[0]);
   return run_code(
-      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "dt = _DT[dtc]\n"
       "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
-      "fac = sk.pdpotrf if dtc == 'd' else sk.pspotrf\n"
-      "slv = sk.pdpotrs if dtc == 'd' else sk.pspotrs\n"
+      "fac = getattr(sk, 'p' + dtc + 'potrf')\n"
+      "slv = getattr(sk, 'p' + dtc + 'potrs')\n"
       "Lf, info = fac(uplo, a.copy())\n"
       "mask = np.tril(np.ones((n, n), bool)) if uplo.lower().startswith('l') "
       "else np.triu(np.ones((n, n), bool))\n"
@@ -307,6 +382,24 @@ int slate_dpotrf(char uplo, int64_t n, double* A, int64_t lda) {
 
 int slate_spotrf(char uplo, int64_t n, float* A, int64_t lda) {
   return posv_impl("s", uplo, n, 0, A, lda, nullptr, 1, 4);
+}
+
+int slate_zposv(char uplo, int64_t n, int64_t nrhs, void* A, int64_t lda,
+                void* B, int64_t ldb) {
+  return posv_impl("z", uplo, n, nrhs, A, lda, B, ldb, 16);
+}
+
+int slate_cposv(char uplo, int64_t n, int64_t nrhs, void* A, int64_t lda,
+                void* B, int64_t ldb) {
+  return posv_impl("c", uplo, n, nrhs, A, lda, B, ldb, 8);
+}
+
+int slate_zpotrf(char uplo, int64_t n, void* A, int64_t lda) {
+  return posv_impl("z", uplo, n, 0, A, lda, nullptr, 1, 16);
+}
+
+int slate_cpotrf(char uplo, int64_t n, void* A, int64_t lda) {
+  return posv_impl("c", uplo, n, 0, A, lda, nullptr, 1, 8);
 }
 
 // ---------------------------------------------------------------------------
@@ -383,6 +476,214 @@ int slate_dgesvd(char jobu, char jobvt, int64_t m, int64_t n, double* A,
       "    vm[:vt.shape[0], :n] = vt\n"
       "info = 0\n",
       c.locals);
+}
+
+static int heev_cz_impl(char dtc, char jobz, char uplo, int64_t n, void* A,
+                        int64_t lda, void* W, int64_t esz, int64_t wsz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", A, lda * n * esz);
+  set_mem(c.locals, "Wbuf", W, n * wsz);
+  set_chr(c.locals, "jobz", jobz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = _DT[dtc]\n"
+      "wdt = np.float64 if dtc == 'z' else np.float32\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
+      "w = np.frombuffer(Wbuf, wdt)[:n]\n"
+      "lam, z = getattr(sk, 'p' + dtc + 'heev')(jobz, uplo, a.copy())\n"
+      "w[...] = np.asarray(lam, wdt)\n"
+      "if jobz.lower() == 'v' and z is not None:\n"
+      "    a[...] = np.asarray(z, dt)\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_zheev(char jobz, char uplo, int64_t n, void* A, int64_t lda,
+                double* W) {
+  return heev_cz_impl('z', jobz, uplo, n, A, lda, W, 16, 8);
+}
+
+int slate_cheev(char jobz, char uplo, int64_t n, void* A, int64_t lda,
+                float* W) {
+  return heev_cz_impl('c', jobz, uplo, n, A, lda, W, 8, 4);
+}
+
+int slate_zgesvd(char jobu, char jobvt, int64_t m, int64_t n, void* A,
+                 int64_t lda, double* S, void* U, int64_t ldu, void* VT,
+                 int64_t ldvt) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t kmin = m < n ? m : n;
+  set_mem(c.locals, "Abuf", A, lda * n * 16);
+  set_mem(c.locals, "Sbuf", S, kmin * 8);
+  if (U != nullptr) set_mem(c.locals, "Ubuf", U, ldu * kmin * 16);
+  if (VT != nullptr) set_mem(c.locals, "Vbuf", VT, ldvt * n * 16);
+  set_chr(c.locals, "jobu", jobu);
+  set_chr(c.locals, "jobvt", jobvt);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldu", ldu);
+  set_int(c.locals, "ldvt", ldvt);
+  return run_code(
+      "k = min(m, n)\n"
+      "a = np.frombuffer(Abuf, np.complex128).reshape((lda, -1), order='F')[:m, :n]\n"
+      "s, u, vt = sk.pzgesvd(jobu, jobvt, a.copy())\n"
+      "np.frombuffer(Sbuf, np.float64)[:k] = np.asarray(np.real(s))[:k]\n"
+      "if u is not None and 'Ubuf' in dir():\n"
+      "    um = np.frombuffer(Ubuf, np.complex128).reshape((ldu, -1), order='F')\n"
+      "    um[:m, :u.shape[1]] = u\n"
+      "if vt is not None and 'Vbuf' in dir():\n"
+      "    vm = np.frombuffer(Vbuf, np.complex128).reshape((ldvt, -1), order='F')\n"
+      "    vm[:vt.shape[0], :n] = vt\n"
+      "info = 0\n",
+      c.locals);
+}
+
+// ---------------------------------------------------------------------------
+// band + indefinite solvers (LAPACK band layouts at the ABI)
+
+static int pbsv_impl(char dtc, char uplo, int64_t n, int64_t kd, int64_t nrhs,
+                     void* AB, int64_t ldab, void* B, int64_t ldb,
+                     int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "ABbuf", AB, ldab * n * esz);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "kd", kd);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "ldab", ldab);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = _DT[dtc]\n"
+      "ab = np.frombuffer(ABbuf, dt).reshape((ldab, -1), order='F')[:, :n]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "# LAPACK band -> dense: lower AB[i-j, j] = A[i, j]; upper\n"
+      "# AB[kd+i-j, j] = A[i, j]\n"
+      "A = np.zeros((n, n), dt)\n"
+      "low = uplo.lower().startswith('l')\n"
+      "for d in range(kd + 1):\n"
+      "    r = ab[d, :n - d] if low else ab[kd - d, d:]\n"
+      "    A += np.diag(r, -d if low else d)\n"
+      "A = A + (np.tril(A, -1) if low else np.triu(A, 1)).conj().T\n"
+      "# factor ONCE, then solve from the factor and write the factor band\n"
+      "# back LAPACK-style (lower storage gets L, upper storage gets U=L^H)\n"
+      "Lf, info = getattr(sk, 'p' + dtc + 'pbtrf')('l', int(kd), A)\n"
+      "if info == 0:\n"
+      "    Lf = np.asarray(Lf, dt)\n"
+      "    b[...] = np.asarray(\n"
+      "        getattr(sk, 'p' + dtc + 'pbtrs')('l', int(kd), Lf, b.copy()), dt)\n"
+      "    for d in range(kd + 1):\n"
+      "        diag = np.diagonal(Lf, -d)\n"
+      "        if low:\n"
+      "            ab[d, :n - d] = diag\n"
+      "        else:\n"
+      "            ab[kd - d, d:] = diag.conj()\n",
+      c.locals);
+}
+
+int slate_dpbsv(char uplo, int64_t n, int64_t kd, int64_t nrhs, double* AB,
+                int64_t ldab, double* B, int64_t ldb) {
+  return pbsv_impl('d', uplo, n, kd, nrhs, AB, ldab, B, ldb, 8);
+}
+
+int slate_spbsv(char uplo, int64_t n, int64_t kd, int64_t nrhs, float* AB,
+                int64_t ldab, float* B, int64_t ldb) {
+  return pbsv_impl('s', uplo, n, kd, nrhs, AB, ldab, B, ldb, 4);
+}
+
+static int gbsv_impl(char dtc, int64_t n, int64_t kl, int64_t ku,
+                     int64_t nrhs, const void* AB, int64_t ldab, void* B,
+                     int64_t ldb, int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  if (ldab < 2 * kl + ku + 1) return -6;   // dgbsv layout required; an ldab
+                                           // heuristic would silently misread
+                                           // compact-layout callers
+  set_mem(c.locals, "ABbuf", const_cast<void*>(AB), ldab * n * esz);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "kl", kl);
+  set_int(c.locals, "ku", ku);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "ldab", ldab);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = _DT[dtc]\n"
+      "ab = np.frombuffer(ABbuf, dt).reshape((ldab, -1), order='F')[:, :n]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "# LAPACK dgbsv layout: AB[kl+ku+i-j, j] = A[i, j] (top kl rows are\n"
+      "# factor workspace, ignored on input)\n"
+      "off = kl + ku\n"
+      "A = np.zeros((n, n), dt)\n"
+      "for d in range(-kl, ku + 1):\n"
+      "    A += np.diag(ab[off - d, max(0, d):n + min(0, d)], d)\n"
+      "X, info = getattr(sk, 'p' + dtc + 'gbsv')(int(kl), int(ku), A, b.copy())\n"
+      "if info == 0:\n"
+      "    b[...] = np.asarray(X, dt)\n",
+      c.locals);
+}
+
+int slate_dgbsv(int64_t n, int64_t kl, int64_t ku, int64_t nrhs,
+                const double* AB, int64_t ldab, double* B, int64_t ldb) {
+  return gbsv_impl('d', n, kl, ku, nrhs, AB, ldab, B, ldb, 8);
+}
+
+int slate_sgbsv(int64_t n, int64_t kl, int64_t ku, int64_t nrhs,
+                const float* AB, int64_t ldab, float* B, int64_t ldb) {
+  return gbsv_impl('s', n, kl, ku, nrhs, AB, ldab, B, ldb, 4);
+}
+
+static int sysv_impl(char dtc, char uplo, int64_t n, int64_t nrhs,
+                     const void* A, int64_t lda, void* B, int64_t ldb,
+                     int64_t esz) {
+  Call c;
+  if (!c.ok) return -999;
+  set_mem(c.locals, "Abuf", const_cast<void*>(A), lda * n * esz);
+  set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "nrhs", nrhs);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "ldb", ldb);
+  set_chr(c.locals, "dtc", dtc);
+  return run_code(
+      "dt = _DT[dtc]\n"
+      "a = np.frombuffer(Abuf, dt).reshape((lda, -1), order='F')[:n, :n]\n"
+      "b = np.frombuffer(Bbuf, dt).reshape((ldb, -1), order='F')[:n, :nrhs]\n"
+      "name = 'hesv' if dtc in 'cz' else 'sysv'\n"
+      "X, info = getattr(sk, 'p' + dtc + name)(uplo, a.copy(), b.copy())\n"
+      "if info == 0:\n"
+      "    b[...] = np.asarray(X, dt)\n",
+      c.locals);
+}
+
+int slate_dsysv(char uplo, int64_t n, int64_t nrhs, const double* A,
+                int64_t lda, double* B, int64_t ldb) {
+  return sysv_impl('d', uplo, n, nrhs, A, lda, B, ldb, 8);
+}
+
+int slate_ssysv(char uplo, int64_t n, int64_t nrhs, const float* A,
+                int64_t lda, float* B, int64_t ldb) {
+  return sysv_impl('s', uplo, n, nrhs, A, lda, B, ldb, 4);
+}
+
+int slate_zhesv(char uplo, int64_t n, int64_t nrhs, const void* A,
+                int64_t lda, void* B, int64_t ldb) {
+  return sysv_impl('z', uplo, n, nrhs, A, lda, B, ldb, 16);
+}
+
+int slate_chesv(char uplo, int64_t n, int64_t nrhs, const void* A,
+                int64_t lda, void* B, int64_t ldb) {
+  return sysv_impl('c', uplo, n, nrhs, A, lda, B, ldb, 8);
 }
 
 // ---------------------------------------------------------------------------
@@ -559,7 +860,7 @@ static int64_t matrix_create_impl(char dtc, int64_t m, int64_t n,
   set_int(c.locals, "lda", lda);
   set_chr(c.locals, "dtc", dtc);
   int64_t h = run_code(
-      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "dt = _DT[dtc]\n"
       "arr = np.frombuffer(Dbuf, dt).reshape((lda, -1), order='F')[:m, :n]\n"
       "_handles[_next_handle[0]] = np.ascontiguousarray(arr).copy()\n"
       "info = _next_handle[0]\n"
@@ -576,6 +877,16 @@ int64_t slate_matrix_create_d(int64_t m, int64_t n, const double* data,
 int64_t slate_matrix_create_s(int64_t m, int64_t n, const float* data,
                               int64_t lda) {
   return matrix_create_impl('s', m, n, data, lda, 4);
+}
+
+int64_t slate_matrix_create_z(int64_t m, int64_t n, const void* data,
+                              int64_t lda) {
+  return matrix_create_impl('z', m, n, data, lda, 16);
+}
+
+int64_t slate_matrix_create_c(int64_t m, int64_t n, const void* data,
+                              int64_t lda) {
+  return matrix_create_impl('c', m, n, data, lda, 8);
 }
 
 static int matrix_read_impl(char dtc, int64_t h, void* out, int64_t ld,
@@ -597,10 +908,12 @@ static int matrix_read_impl(char dtc, int64_t h, void* out, int64_t ld,
   PyObject* co = PyDict_GetItemString(c.locals, "cols");
   if (ro == nullptr || co == nullptr) return -1;
   int64_t cols = PyLong_AsLongLong(co);
-  (void)ro;
+  int64_t rows = PyLong_AsLongLong(ro);
+  if (ld < rows) return -7;   // undersized ld: distinct code, not a broadcast
+                              // exception surfaced as a generic failure
   set_mem(c.locals, "Obuf", out, ld * cols * esz);
   return run_code(
-      "dt = np.float64 if dtc == 'd' else np.float32\n"
+      "dt = _DT[dtc]\n"
       "om = np.frombuffer(Obuf, dt).reshape((ld, -1), order='F')\n"
       "om[:rows, :cols] = a\n"
       "info = 0\n",
@@ -613,6 +926,14 @@ int slate_matrix_read_d(int64_t h, double* out, int64_t ld) {
 
 int slate_matrix_read_s(int64_t h, float* out, int64_t ld) {
   return matrix_read_impl('s', h, out, ld, 4);
+}
+
+int slate_matrix_read_z(int64_t h, void* out, int64_t ld) {
+  return matrix_read_impl('z', h, out, ld, 16);
+}
+
+int slate_matrix_read_c(int64_t h, void* out, int64_t ld) {
+  return matrix_read_impl('c', h, out, ld, 8);
 }
 
 void slate_matrix_destroy(int64_t h) {
@@ -680,6 +1001,90 @@ int slate_matrix_gesv(int64_t hA, int64_t hB) {
       "        _handles[int(hb)] = np.asarray(\n"
       "            slv('n', lu, piv, b.copy()), b.dtype)\n",
       c.locals);
+}
+
+int slate_matrix_syev(int64_t h, char jobz, char uplo, double* W) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "h", h);
+  set_chr(c.locals, "jobz", jobz);
+  set_chr(c.locals, "uplo", uplo);
+  // stage 1: size the W view from the handle
+  int rc = run_code(
+      "a = _handles.get(int(h))\n"
+      "info = 0 if a is not None else -1\n"
+      "if a is not None:\n"
+      "    rows = a.shape[0]\n",
+      c.locals);
+  if (rc != 0) return rc;
+  PyObject* ro = PyDict_GetItemString(c.locals, "rows");
+  if (ro == nullptr) return -1;
+  int64_t n = PyLong_AsLongLong(ro);
+  set_mem(c.locals, "Wbuf", W, n * 8);
+  return run_code(
+      "letter = {np.dtype(np.float32): 's', np.dtype(np.float64): 'd',\n"
+      "          np.dtype(np.complex64): 'c', np.dtype(np.complex128): 'z'}"
+      "[a.dtype]\n"
+      "name = 'heev' if letter in 'cz' else 'syev'\n"
+      "lam, z = getattr(sk, 'p' + letter + name)(jobz, uplo, a.copy())\n"
+      "np.frombuffer(Wbuf, np.float64)[:rows] = np.asarray(lam, np.float64)\n"
+      "if jobz.lower() == 'v' and z is not None:\n"
+      "    _handles[int(h)] = np.asarray(z, a.dtype)\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_matrix_gesvd(int64_t h, double* S, int64_t* hU, int64_t* hVT) {
+  Call c;
+  if (!c.ok) return -999;
+  set_int(c.locals, "h", h);
+  set_int(c.locals, "wantu", hU != nullptr);
+  set_int(c.locals, "wantv", hVT != nullptr);
+  int rc = run_code(
+      "a = _handles.get(int(h))\n"
+      "info = 0 if a is not None else -1\n"
+      "if a is not None:\n"
+      "    kmin = min(a.shape)\n",
+      c.locals);
+  if (rc != 0) return rc;
+  PyObject* ko = PyDict_GetItemString(c.locals, "kmin");
+  if (ko == nullptr) return -1;
+  int64_t k = PyLong_AsLongLong(ko);
+  set_mem(c.locals, "Sbuf", S, k * 8);
+  rc = run_code(
+      "letter = {np.dtype(np.float32): 's', np.dtype(np.float64): 'd',\n"
+      "          np.dtype(np.complex64): 'c', np.dtype(np.complex128): 'z'}"
+      "[a.dtype]\n"
+      "ju = 's' if wantu else 'n'\n"
+      "jv = 's' if wantv else 'n'\n"
+      "s, u, vt = getattr(sk, 'p' + letter + 'gesvd')(ju, jv, a.copy())\n"
+      "np.frombuffer(Sbuf, np.float64)[:kmin] = "
+      "np.asarray(np.real(s), np.float64)[:kmin]\n"
+      "hu = hv = 0\n"
+      "if wantu and u is not None:\n"
+      "    _handles[_next_handle[0]] = np.ascontiguousarray("
+      "np.asarray(u, a.dtype))\n"
+      "    hu = _next_handle[0]; _next_handle[0] += 1\n"
+      "if wantv and vt is not None:\n"
+      "    _handles[_next_handle[0]] = np.ascontiguousarray("
+      "np.asarray(vt, a.dtype))\n"
+      "    hv = _next_handle[0]; _next_handle[0] += 1\n"
+      "info = 0\n",
+      c.locals);
+  if (rc != 0) return rc;
+  if (hU != nullptr) {
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    PyObject* v = PyDict_GetItemString(c.locals, "hu");
+    *hU = v != nullptr ? PyLong_AsLongLong(v) : 0;
+    PyGILState_Release(g2);
+  }
+  if (hVT != nullptr) {
+    PyGILState_STATE g2 = PyGILState_Ensure();
+    PyObject* v = PyDict_GetItemString(c.locals, "hv");
+    *hVT = v != nullptr ? PyLong_AsLongLong(v) : 0;
+    PyGILState_Release(g2);
+  }
+  return 0;
 }
 
 double slate_dlange(char norm, int64_t m, int64_t n, const double* A,

@@ -235,7 +235,13 @@ class Options:
                                     # CALU tournament panels MXU/lane-aligned
                                     # (the reference's CPU default is far
                                     # smaller; tournament merge flops scale as
-                                    # ib^2 so this is the TPU sweet spot)
+                                    # ib^2 so this is the TPU sweet spot).
+                                    # NOTE: at the default block_size (256) the
+                                    # two-level CALU split degenerates to a
+                                    # single-level panel (ib == nb by design —
+                                    # two levels only pay off at large nb); the
+                                    # inner level engages when callers raise nb
+                                    # (bench.py's getrf runs nb=2048, ib=256)
     max_panel_threads: int = 1      # kept for parity; no host thread teams on TPU
     tolerance: Optional[float] = None  # Option::Tolerance (mixed-precision IR)
     max_iterations: int = 30        # Option::MaxIterations (IR)

@@ -278,6 +278,49 @@ def _svd_finish(s, u, vt, jobu, jobvt, m, n):
     return np.asarray(s), u, vt
 
 
+def _pbsv(dt, uplo, kd, a, b):
+    """SPD band solve (lapack_api/lapack_pbsv.cc).  ``a`` is the DENSE banded
+    matrix (the skin's simplified shapes); ``kd`` its half-bandwidth.  Returns
+    (X, info)."""
+    a, b = _as(dt, a, b)
+    X, info = _la.pbsv(a.copy(), b.copy(), _opts(), uplo=uplo, kd=int(kd))
+    return np.asarray(X), int(info)
+
+
+def _pbtrf(dt, uplo, kd, a):
+    """Band Cholesky factor (lapack_pbtrf.cc): dense banded in, dense lower
+    band factor out.  Returns (L, info)."""
+    (a,) = _as(dt, a)
+    Lb, info = _la.pbtrf(a.copy(), _opts(), uplo=uplo, kd=int(kd))
+    return np.asarray(Lb), int(info)
+
+
+def _pbtrs(dt, uplo, kd, lf, b):
+    """Solve from the band Cholesky factor (lapack_pbtrs.cc); ``lf`` is the
+    dense LOWER band factor _pbtrf returns (uplo records the original
+    storage and is accepted for call-shape parity)."""
+    lf, b = _as(dt, lf, b)
+    X = _la.pbtrs(lf, b.copy(), _opts(), kd=int(kd))
+    return np.asarray(X)
+
+
+def _gbsv(dt, kl, ku, a, b):
+    """General band solve (lapack_gbsv.cc): dense banded in.  Returns
+    (X, info)."""
+    a, b = _as(dt, a, b)
+    X, info = _la.gbsv(a.copy(), b.copy(), _opts(), kl=int(kl), ku=int(ku))
+    return np.asarray(X), int(info)
+
+
+def _hesv(dt, uplo, a, b, *, sy=False):
+    """Symmetric/Hermitian-indefinite solve via CA-Aasen (lapack_hesv.cc);
+    returns (X, info)."""
+    a, b = _as(dt, a, b)
+    fn = _la.sysv if sy else _la.hesv
+    X, info = fn(a.copy(), b.copy(), _opts(), uplo=uplo)
+    return np.asarray(X), int(info)
+
+
 def _gesvd(dt, jobu, jobvt, a):
     (a,) = _as(dt, a)
     m, n = a.shape
@@ -310,6 +353,9 @@ _FAMILIES = {
     "syev": (_heev, {"sy": True}), "syevd": (_heev, {"sy": True}),
     "hegv": (_hegv, {}), "sygv": (_hegv, {"sy": True}),
     "gesvd": (_gesvd, {}),
+    "pbsv": (_pbsv, {}), "pbtrf": (_pbtrf, {}), "pbtrs": (_pbtrs, {}),
+    "gbsv": (_gbsv, {}),
+    "hesv": (_hesv, {}), "sysv": (_hesv, {"sy": True}),
 }
 
 # complex-only / real-only aliasing like LAPACK: cheev/zheev but ssyev/dsyev
@@ -319,6 +365,11 @@ _SKIP = {
     ("s", "heev"), ("d", "heev"), ("s", "heevd"), ("d", "heevd"),
     ("c", "syev"), ("z", "syev"), ("c", "syevd"), ("z", "syevd"),
     ("s", "hegv"), ("d", "hegv"), ("c", "sygv"), ("z", "sygv"),
+    ("s", "hesv"), ("d", "hesv"),   # LAPACK: ssysv/dsysv but chesv/zhesv
+    # LAPACK's csysv/zsysv solve complex *symmetric* (A == A.T) systems;
+    # the backend's indefinite solver is Hermitian CA-Aasen — exposing the
+    # names would silently factor conj-mirrored matrices.  Not offered.
+    ("c", "sysv"), ("z", "sysv"),
 }
 
 __all__ = []

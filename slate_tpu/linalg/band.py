@@ -547,8 +547,17 @@ def gbsv(A, B, opts=None, kl=None, ku=None):
                                       nb=opts_.block_size)
         nd = fac.lub.shape[0]
         wr = nd - kl_v - ku_v
-        write_back(A, band_general_to_dense(fac.lub, a.shape[-1],
-                                            wr - 1, ku_v, extra=kl_v))
+        from ..core.matrix import BaseBandMatrix
+
+        if not (isinstance(A, BaseBandMatrix)
+                and getattr(A, "kl", kl_v) < wr - 1):
+            # in-place contract: factored form back into A.  Skipped when A
+            # is a band wrapper whose storage holds only kl subdiagonals —
+            # pivoting widens L's multipliers to wr-1 > kl, and a masked
+            # write-back would silently truncate them into a non-factor;
+            # solves still ride the returned `fac` either way.
+            write_back(A, band_general_to_dense(fac.lub, a.shape[-1],
+                                                wr - 1, ku_v, extra=kl_v))
         x = gbtrs_distributed(fac, as_array(B), grid)
         return write_back(B, x), info
     fac, info = gbtrf(A, opts, kl, ku)

@@ -712,6 +712,18 @@ def gesv_rbt(A, B, opts=None, key=None):
     opts = Options.make(opts)
     a = as_array(A)
     b = as_array(B)
+    grid = distribution_grid(A)
+    if grid is not None:
+        # construction-time grid: the sharded butterfly + nopiv-LU + IR path
+        # (parallel/rbt.py), like every other driver's grid dispatch
+        from ..parallel.rbt import gesv_rbt_distributed
+
+        X, info, iters = gesv_rbt_distributed(
+            a, b, grid, depth=opts.depth,
+            nb=min(opts.block_size, a.shape[-1]), key=key,
+            max_iterations=opts.max_iterations,
+            use_fallback=opts.use_fallback_solver, tol=opts.tolerance)
+        return write_back(B, X), info, iters
     n = a.shape[-1]
     depth = opts.depth
     # pad n to a multiple of 2^depth for the butterfly recursion

@@ -528,7 +528,7 @@ def unmbr_tb2bd(side, op, Q, C, opts=None):
     return _apply_q(side, op, Q, C)
 
 
-def bdsqr(d, e, opts=None, want_vectors: bool = False):
+def bdsqr(d, e, opts=None, want_vectors: bool = False, method: str = "auto"):
     """Bidiagonal SVD (src/bdsqr.cc wraps lapack::bdsqr, svd.cc:354-359).
 
     Values-only at scale: Sturm bisection on the Golub–Kahan form — the
@@ -541,15 +541,25 @@ def bdsqr(d, e, opts=None, want_vectors: bool = False):
     Accuracy envelope: like LAPACK's bisection (stebz/bdsvdx), the large-k
     values path delivers *absolute* accuracy O(eps·σ_max); singular values
     near σ_max·eps therefore carry no relative digits (bdsqr's QR iteration
-    is relatively accurate there).  Callers needing full relative accuracy
-    of tiny σ at k > _STEV_DENSE_MAX should take the vectors path.
+    is relatively accurate there).  ``method`` controls the trade:
+    "auto" (default) bisects above _STEV_DENSE_MAX, "dense" forces the
+    fused XLA SVD at any size (full relative accuracy of tiny σ, O(k³)),
+    "bisect" forces the Golub–Kahan bisection (values only).
     """
     from .eig import _STEV_DENSE_MAX
+    from ..core.exceptions import slate_assert
 
+    slate_assert(method in ("auto", "dense", "bisect"),
+                 f"bdsqr: unknown method '{method}'")
+    slate_assert(not (want_vectors and method == "bisect"),
+                 "bdsqr: the Golub–Kahan bisection is values-only; "
+                 "want_vectors needs method='auto' or 'dense'")
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     k = d.shape[-1]
-    if not want_vectors and k > _STEV_DENSE_MAX:
+    use_bisect = (method == "bisect"
+                  or (method == "auto" and k > _STEV_DENSE_MAX))
+    if not want_vectors and use_bisect:
         from .sturm import sterf_bisect
 
         tgk_off = jnp.zeros((2 * k - 1,), d.dtype)

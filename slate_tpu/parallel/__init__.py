@@ -43,4 +43,5 @@ from .band_dist import (pbtrf_distributed, pbtrs_distributed, pbsv_distributed,
                         band_general_to_dense)
 from .indefinite_dist import (hetrf_distributed, hetrs_distributed,
                               hesv_distributed, HermitianFactorsDist)
+from .rbt import getrf_nopiv_distributed, gesv_rbt_distributed
 from .pipeline import potrf_pipelined

@@ -53,6 +53,64 @@ from .pivot import (exchange_rows as _exchange_rows,
                     step_permutation, tournament_piv)
 
 
+def _panel_tail(A_loc, pan, LUkk, k0, grow, gcol, pi, qi, mr, mc, nb):
+    """Shared post-factor panel pipeline of the 2-D LU variants (tournament
+    and nopiv — parallel/rbt.py): panel L via trsm against Ukk, packed
+    L\\U write-back on the owner mesh column, U row band psum-bcast along p,
+    masked full-width trailing gemm.  One implementation so the two
+    factorizations cannot drift."""
+    po = k0 // mr
+    roff = k0 - po * mr
+    qo = k0 // mc
+    off = k0 - qo * mc
+
+    Ukk = jnp.triu(LUkk)
+    # L below the block: X = pan · Ukk^{-1}, valid for rows ≥ k0+nb
+    X = lax.linalg.triangular_solve(Ukk, pan, left_side=False, lower=False)
+    below = grow >= (k0 + nb)
+    Lmask = jnp.where(below[:, None], X, jnp.zeros_like(X))
+
+    # write the packed panel column back (owner mesh column only): rows < k0
+    # keep U history; block rows get packed L\U; rows below get L.  Every
+    # device knows LUkk (replicated by the psum before the factor).
+    in_blk = (grow >= k0) & (grow < k0 + nb)
+    packed = jnp.where(in_blk[:, None],
+                       lax.dynamic_update_slice(
+                           jnp.zeros((mr, nb), pan.dtype), LUkk,
+                           (roff, jnp.int32(0))),
+                       jnp.where(below[:, None], Lmask, pan))
+    newA = lax.dynamic_update_slice(A_loc, packed, (jnp.int32(0), off))
+    A_loc = jnp.where(qi == qo, newA, A_loc)
+
+    # U row band: U = Lkk^{-1} · A[k0:k0+nb, :], bcast along p
+    rb = lax.dynamic_slice(A_loc, (roff, jnp.int32(0)), (nb, mc))
+    rb = jnp.where(pi == po, rb, jnp.zeros_like(rb))
+    rb = lax.psum(rb, ROW_AXIS)                # (nb, mc) everywhere
+    U_loc = lax.linalg.triangular_solve(jnp.tril(LUkk), rb,
+                                        left_side=True, lower=True,
+                                        unit_diagonal=True)
+    ucols = gcol >= (k0 + nb)
+    Umask = jnp.where(ucols[None, :], U_loc, jnp.zeros_like(U_loc))
+    new_rows = jnp.where(ucols[None, :], U_loc, rb)
+    rowband = lax.dynamic_update_slice(A_loc, new_rows, (roff, jnp.int32(0)))
+    A_loc = jnp.where(pi == po, rowband, A_loc)
+
+    # trailing update: full-width masked MXU gemm
+    return A_loc - jnp.matmul(Lmask, Umask, precision=lax.Precision.HIGHEST)
+
+
+def _lu_diag_info(A_loc, grow, gcol, npad):
+    """First bad U diagonal (0 or non-finite), psum-assembled — the
+    reduce_info analogue shared by the 2-D LU variants."""
+    dmask = grow[:, None] == gcol[None, :]
+    drow = jnp.sum(jnp.where(dmask, A_loc, jnp.zeros_like(A_loc)), axis=1)
+    diag = jnp.zeros((npad,), A_loc.dtype).at[grow].set(drow)
+    diag = lax.psum(lax.psum(diag, ROW_AXIS), COL_AXIS)
+    bad = (diag == 0) | ~jnp.isfinite(diag)
+    return jnp.where(jnp.any(bad),
+                     jnp.argmax(bad).astype(jnp.int32) + 1, jnp.int32(0))
+
+
 @lru_cache(maxsize=32)
 def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     """Build the jitted shard_map tournament-LU over an npad×npad matrix."""
@@ -113,58 +171,18 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
                             lax.dynamic_update_slice(pan, pan_blk, (roff, jnp.int32(0))),
                             pan)
 
-            Ukk = jnp.triu(LUkk)
-            # L below the block: X = pan · Ukk^{-1}, valid for rows ≥ k0+nb
-            X = lax.linalg.triangular_solve(Ukk, pan, left_side=False,
-                                            lower=False)
-            below = grow >= (k0 + nb)
-            Lmask = jnp.where(below[:, None], X, jnp.zeros_like(X))
-
-            # write the packed panel column back (owner mesh column only):
-            # rows < k0 keep U history; block rows get packed L\U; rows below
-            # get L
-            # every device knows LUkk (replicated by the psum above) — place it
-            # directly at its block rows
-            in_blk = (grow >= k0) & (grow < k0 + nb)
-            packed = jnp.where(in_blk[:, None],
-                               lax.dynamic_update_slice(
-                                   jnp.zeros((mr, nb), pan.dtype), LUkk,
-                                   (roff, jnp.int32(0))),
-                               jnp.where(below[:, None], Lmask, pan))
-            qo = k0 // mc
-            off = k0 - qo * mc
-            newA = lax.dynamic_update_slice(A_loc, packed, (jnp.int32(0), off))
-            A_loc = jnp.where(qi == qo, newA, A_loc)
-
-            # ---- U row band: U = Lkk^{-1} · A[k0:k0+nb, :], bcast along p
-            rb = lax.dynamic_slice(A_loc, (roff, jnp.int32(0)), (nb, mc))
-            rb = jnp.where(pi == po, rb, jnp.zeros_like(rb))
-            rb = lax.psum(rb, ROW_AXIS)                # (nb, mc) everywhere
-            U_loc = lax.linalg.triangular_solve(jnp.tril(LUkk), rb,
-                                                left_side=True, lower=True,
-                                                unit_diagonal=True)
-            ucols = gcol >= (k0 + nb)
-            Umask = jnp.where(ucols[None, :], U_loc, jnp.zeros_like(U_loc))
-            new_rows = jnp.where(ucols[None, :], U_loc, rb)
-            rowband = lax.dynamic_update_slice(A_loc, new_rows, (roff, jnp.int32(0)))
-            A_loc = jnp.where(pi == po, rowband, A_loc)
-
-            # ---- trailing update: full-width masked MXU gemm
-            A_loc = A_loc - jnp.matmul(Lmask, Umask,
-                                       precision=lax.Precision.HIGHEST)
+            # ---- shared post-factor pipeline (panel L, packed write, U row
+            # band, trailing gemm — one source of truth with the nopiv
+            # variant, parallel/rbt.py)
+            A_loc = _panel_tail(A_loc, pan, LUkk, k0, grow, gcol, pi, qi,
+                                mr, mc, nb)
             return A_loc, perm
 
         perm0 = jnp.arange(npad, dtype=jnp.int32)
         A_loc, perm = lax.fori_loop(0, nt, step, (A_loc, perm0))
 
-        # info: first zero diagonal of U (functional, reduce_info analogue)
-        dmask = grow[:, None] == gcol[None, :]
-        drow = jnp.sum(jnp.where(dmask, A_loc, jnp.zeros_like(A_loc)), axis=1)
-        diag = jnp.zeros((npad,), A_loc.dtype).at[grow].set(drow)
-        diag = lax.psum(lax.psum(diag, ROW_AXIS), COL_AXIS)
-        info = jnp.where(jnp.any(diag == 0),
-                         jnp.argmax(diag == 0).astype(jnp.int32) + 1,
-                         jnp.int32(0))
+        # info: first bad diagonal of U (functional, reduce_info analogue)
+        info = _lu_diag_info(A_loc, grow, gcol, npad)
         return A_loc, perm, info
 
     spec = P(ROW_AXIS, COL_AXIS)

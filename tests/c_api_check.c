@@ -255,6 +255,147 @@ int main(void) {
     free(A); free(B); free(X);
   }
 
+  /* complex: zgesv + zgemm round trip.  Buffers are interleaved (re, im). */
+  {
+    double *A = malloc(n * n * 16), *As = malloc(n * n * 16);
+    double *B = malloc(n * nrhs * 16), *Bs = malloc(n * nrhs * 16);
+    int64_t *piv = malloc(n * 8);
+    for (int64_t i = 0; i < n * n * 2; ++i) As[i] = A[i] = frand();
+    for (int64_t i = 0; i < n * nrhs * 2; ++i) Bs[i] = B[i] = frand();
+    int info = slate_zgesv(n, nrhs, A, n, piv, B, n);
+    /* residual R = As X - Bs via zgemm: alpha = 1, beta = -1 */
+    double one[2] = {1.0, 0.0}, mone[2] = {-1.0, 0.0};
+    slate_zgemm('n', 'n', n, nrhs, n, one, As, n, B, n, mone, Bs, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n * nrhs * 2; ++i)
+      if (fabs(Bs[i]) > maxe) maxe = fabs(Bs[i]);
+    fails += check("zgesv", maxe, 1e-10);
+    free(A); free(As); free(B); free(Bs); free(piv);
+  }
+
+  /* band SPD: dpbsv on LAPACK lower band storage */
+  {
+    const int64_t kd = 3, ldab = kd + 1;
+    double *AB = calloc(ldab * n, 8), *Af = calloc(n * n, 8);
+    double *B = malloc(n * 8), *Bs = malloc(n * 8);
+    /* diagonally dominant SPD band, built directly in band storage */
+    for (int64_t j = 0; j < n; ++j) {
+      AB[0 + j * ldab] = 4.0 * (kd + 1);
+      Af[j + j * n] = AB[0 + j * ldab];
+      for (int64_t d = 1; d <= kd && j + d < n; ++d) {
+        double v = frand();
+        AB[d + j * ldab] = v;
+        Af[(j + d) + j * n] = v;
+        Af[j + (j + d) * n] = v;
+      }
+    }
+    for (int64_t i = 0; i < n; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dpbsv('l', n, kd, 1, AB, ldab, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n; ++i) {
+      double acc = 0;
+      for (int64_t k = 0; k < n; ++k) acc += Af[i + k * n] * B[k];
+      if (fabs(acc - Bs[i]) > maxe) maxe = fabs(acc - Bs[i]);
+    }
+    fails += check("dpbsv", maxe, 1e-10);
+    free(AB); free(Af); free(B); free(Bs);
+  }
+
+  /* general band: dgbsv on LAPACK dgbsv storage (kl extra factor rows) */
+  {
+    const int64_t kl = 2, ku = 1, ldab = 2 * kl + ku + 1;
+    double *AB = calloc(ldab * n, 8), *Af = calloc(n * n, 8);
+    double *B = malloc(n * 8), *Bs = malloc(n * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t d = -ku; d <= kl; ++d) {   /* rows i = j+d in column j */
+        int64_t i = j + d;
+        if (i < 0 || i >= n) continue;
+        double v = (d == 0) ? 4.0 + frand() : frand();
+        AB[(kl + ku + d) + j * ldab] = v;      /* AB[kl+ku+i-j, j] */
+        Af[i + j * n] = v;
+      }
+    for (int64_t i = 0; i < n; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dgbsv(n, kl, ku, 1, AB, ldab, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n; ++i) {
+      double acc = 0;
+      for (int64_t k = 0; k < n; ++k) acc += Af[i + k * n] * B[k];
+      if (fabs(acc - Bs[i]) > maxe) maxe = fabs(acc - Bs[i]);
+    }
+    fails += check("dgbsv", maxe, 1e-10);
+    free(AB); free(Af); free(B); free(Bs);
+  }
+
+  /* symmetric indefinite: dsysv (CA-Aasen under the hood) */
+  {
+    double *A = malloc(n * n * 8), *B = malloc(n * 8), *Bs = malloc(n * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i <= j; ++i) {
+        double v = frand();
+        A[i + j * n] = v;
+        A[j + i * n] = v;
+      }
+    for (int64_t i = 0; i < n; ++i) Bs[i] = B[i] = frand();
+    int info = slate_dsysv('l', n, 1, A, n, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n; ++i) {
+      double acc = 0;
+      for (int64_t k = 0; k < n; ++k) acc += A[i + k * n] * B[k];
+      if (fabs(acc - Bs[i]) > maxe) maxe = fabs(acc - Bs[i]);
+    }
+    fails += check("dsysv", maxe, 1e-9);
+    free(A); free(B); free(Bs);
+  }
+
+  /* handle eigensolve + SVD: syev overwrites the handle with vectors; gesvd
+   * returns new U/VT handles */
+  {
+    double *A = malloc(n * n * 8), *W = malloc(n * 8), *Z = malloc(n * n * 8);
+    double *S = malloc(n * 8), *U = malloc(n * n * 8), *VT = malloc(n * n * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i <= j; ++i) {
+        double v = frand();
+        A[i + j * n] = v;
+        A[j + i * n] = v;
+      }
+    int64_t h = slate_matrix_create_d(n, n, A, n);
+    int info = slate_matrix_syev(h, 'v', 'l', W);
+    slate_matrix_read_d(h, Z, n);
+    double maxe = (info == 0 && h > 0) ? 0 : 1e9;
+    for (int64_t j = 0; j < n; ++j)       /* A z_j = w_j z_j */
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = 0;
+        for (int64_t k = 0; k < n; ++k) acc += A[i + k * n] * Z[k + j * n];
+        double d = fabs(acc - W[j] * Z[i + j * n]);
+        if (d > maxe) maxe = d;
+      }
+    fails += check("h-syev", maxe, 1e-9);
+    /* undersized ld must fail with a distinct code, not garbage */
+    fails += check("h-read-ld", slate_matrix_read_d(h, Z, n - 1) == -7 ? 0 : 1,
+                   0.5);
+    slate_matrix_destroy(h);
+
+    int64_t h2 = slate_matrix_create_d(n, n, A, n), hU = 0, hVT = 0;
+    info = slate_matrix_gesvd(h2, S, &hU, &hVT);
+    maxe = (info == 0 && hU > 0 && hVT > 0) ? 0 : 1e9;
+    if (maxe == 0) {
+      slate_matrix_read_d(hU, U, n);
+      slate_matrix_read_d(hVT, VT, n);
+      for (int64_t j = 0; j < n; ++j)     /* A = U diag(S) VT */
+        for (int64_t i = 0; i < n; ++i) {
+          double acc = 0;
+          for (int64_t k = 0; k < n; ++k)
+            acc += U[i + k * n] * S[k] * VT[k + j * n];
+          double d = fabs(acc - A[i + j * n]);
+          if (d > maxe) maxe = d;
+        }
+    }
+    fails += check("h-gesvd", maxe, 1e-9);
+    slate_matrix_destroy(h2); slate_matrix_destroy(hU);
+    slate_matrix_destroy(hVT);
+    free(A); free(W); free(Z); free(S); free(U); free(VT);
+  }
+
   /* gridinit path: same posv through a 2x4 grid when 8 devices exist */
   {
     if (slate_gridinit(2, 4) == 0) {

@@ -457,3 +457,50 @@ class TestStragglersSharding:
         assert c8.memory_analysis().argument_size_in_bytes == \
             2 * n * n * 4 // 8
         assert c8.as_text().count("all-gather") >= 1
+
+
+class TestRbtDist:
+    """Distributed random-butterfly solver (src/gesv_rbt.cc:94-172 over the
+    mesh) — the last LU-family variant to get a mesh path (VERDICT r3 #9)."""
+
+    def test_getrf_nopiv_distributed_factor(self, grid24, rng):
+        from slate_tpu.parallel import getrf_nopiv_distributed
+
+        n = 200
+        A = rng.standard_normal((n, n)) + n * np.eye(n)   # nopiv-safe
+        LU, info = getrf_nopiv_distributed(jnp.asarray(A), grid24, nb=32)
+        L = np.tril(np.asarray(LU), -1) + np.eye(n)
+        U = np.triu(np.asarray(LU))
+        assert int(info) == 0
+        assert np.linalg.norm(L @ U - A) / np.linalg.norm(A) < 1e-12
+
+    def test_gesv_rbt_distributed_solves(self, grid24, rng):
+        from slate_tpu.parallel import gesv_rbt_distributed
+
+        n = 180
+        A = rng.standard_normal((n, n))
+        Xt = rng.standard_normal((n, 3))
+        B = A @ Xt
+        X, info, iters = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B),
+                                              grid24, depth=2, nb=32)
+        assert int(info) == 0
+        assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10
+        # vector RHS keeps its shape
+        x1, _, _ = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B[:, 0]),
+                                        grid24, depth=2, nb=32)
+        assert x1.shape == (n,)
+        assert np.linalg.norm(np.asarray(x1) - Xt[:, 0]) < 1e-9
+
+    def test_driver_grid_dispatch(self, grid24, rng):
+        """slate.gesv_rbt consumes a construction-time grid like every other
+        driver (reference: distribution installed at construction)."""
+        import slate_tpu as slate
+
+        n = 96
+        A = rng.standard_normal((n, n))
+        Xt = rng.standard_normal((n, 2))
+        B = A @ Xt
+        M = slate.Matrix.from_array(jnp.asarray(A), grid=grid24)
+        X, info, iters = slate.gesv_rbt(M, jnp.asarray(B),
+                                        opts={"block_size": 16})
+        assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10
