@@ -525,7 +525,11 @@ def child_heev2s(cpu_fallback):
     import slate_tpu
 
     def run(x):
-        lam, _ = slate_tpu.heev(x, want_vectors=False, method="two_stage")
+        # chase_pipeline: the multi-sweep batched chase (hb2st.cc's pass/step
+        # concurrency) — the accelerator-shaped stage 2; the sequential
+        # window form is for CPU (linalg/eig.py hb2st docstring)
+        lam, _ = slate_tpu.heev(x, want_vectors=False, method="two_stage",
+                                chase_pipeline=not cpu_fallback)
         return lam
 
     def make_input(j):

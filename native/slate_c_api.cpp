@@ -552,6 +552,8 @@ static int pbsv_impl(char dtc, char uplo, int64_t n, int64_t kd, int64_t nrhs,
                      int64_t esz) {
   Call c;
   if (!c.ok) return -999;
+  if (ldab < kd + 1) return -6;   // LAPACK-style argument error, matching
+                                  // gbsv's undersized-ldab contract
   set_mem(c.locals, "ABbuf", AB, ldab * n * esz);
   set_mem(c.locals, "Bbuf", B, ldb * nrhs * esz);
   set_chr(c.locals, "uplo", uplo);

@@ -94,11 +94,14 @@ def _getrf_nopiv_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
-def getrf_nopiv_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
+def getrf_nopiv_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
+                            trim: bool = True):
     """Distributed LU without pivoting (src/getrf_nopiv.cc over the grid).
 
     Returns ``(LU, info)``; info = 1-based index of the first zero U diagonal
-    (breakdown), 0 on success.  Identity-tail padding to shard boundaries.
+    (breakdown), 0 on success.  Identity-tail padding to shard boundaries;
+    ``trim=False`` returns the factor at its padded size (the tail is a
+    factored identity) so repeated solves avoid re-padding per call.
     """
     n = A.shape[-1]
     slate_assert(A.ndim == 2 and A.shape[0] == n,
@@ -113,7 +116,7 @@ def getrf_nopiv_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     LU, info = _getrf_nopiv_dist_fn(grid.mesh, npad, min(nb, npad),
                                     str(Ap.dtype))(Ap)
     info = jnp.where(info > n, jnp.int32(0), info)  # pad diag is never 0
-    return LU[:n, :n], info
+    return (LU[:n, :n] if trim else LU), info
 
 
 @lru_cache(maxsize=1)
@@ -140,7 +143,7 @@ def gesv_rbt_distributed(A, B, grid: ProcessGrid, depth: int = 2,
     """
     from ..linalg.lu import _butterfly_apply, rbt_generate
     from .lu_dist import gesv_distributed
-    from .solvers import _ir_refine_distributed, trsm_distributed
+    from .solvers import _ir_refine_distributed, _trsm_dist_fn
 
     a = jnp.asarray(A)
     b = jnp.asarray(B)
@@ -160,18 +163,27 @@ def gesv_rbt_distributed(A, B, grid: ProcessGrid, depth: int = 2,
     # two-sided transform U^T A V under GSPMD: the level mixes lower to the
     # pairwise shard exchanges the reference's gerbt.cc posts as MPI swaps
     at = _transform_jit()(ap, Wu, Wv)
-    LU, info = getrf_nopiv_distributed(at, grid, nb=nb)
-    eyen = jnp.eye(np_, dtype=LU.dtype)
-    L = jnp.tril(LU, -1) + eyen
-    U = jnp.triu(LU)
+    # keep the factor at its padded size: the L/U triangles are device_put
+    # ONCE, and the per-iteration solves reuse the cached sharded trsm
+    # programs directly — no re-pad / re-place inside the IR loop body
+    LUp, info = getrf_nopiv_distributed(at, grid, nb=nb, trim=False)
+    npad2 = LUp.shape[-1]
+    L = jax.device_put(jnp.tril(LUp, -1) + jnp.eye(npad2, dtype=LUp.dtype),
+                       grid.spec())
+    U = jax.device_put(jnp.triu(LUp), grid.spec())
+    solveL = _trsm_dist_fn(grid.mesh, True, False, str(LUp.dtype))
+    solveU = _trsm_dist_fn(grid.mesh, False, False, str(LUp.dtype))
+    nrhs = b2.shape[-1]
+    cpad = ceil_mult(max(nrhs, 1), grid.q)
 
     def solve_lo(R):                      # R: (n, nrhs) working precision
-        rp = jnp.pad(R, ((0, np_ - n), (0, 0)))
+        rp = jnp.pad(R, ((0, np_ - n), (0, cpad - nrhs)))
         y = _butterfly_apply(Wu, rp, transpose=True)
-        z = trsm_distributed(L, y, grid, lower=True)
-        w = trsm_distributed(U, z, grid, lower=False)
-        x = _butterfly_apply(Wv, w, transpose=False)
-        return x[:n]
+        y = jnp.pad(y, ((0, npad2 - np_), (0, 0)))  # identity tail: zeros
+        z = solveL(L, y)
+        w = solveU(U, z)
+        x = _butterfly_apply(Wv, w[:np_], transpose=False)
+        return x[:n, :nrhs]
 
     X, iters, ok = _ir_refine_distributed(a, b2, solve_lo, grid,
                                           max_iterations, tol=tol)

@@ -5,6 +5,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "slate_tpu.h"
 
@@ -271,6 +272,101 @@ int main(void) {
       if (fabs(Bs[i]) > maxe) maxe = fabs(Bs[i]);
     fails += check("zgesv", maxe, 1e-10);
     free(A); free(As); free(B); free(Bs); free(piv);
+  }
+
+  /* complex HPD + Hermitian eigen: zposv then zheev values on the same A */
+  {
+    double *A = malloc(n * n * 16), *G = malloc(n * n * 16);
+    double *B = malloc(n * 16), *Bs = malloc(n * 16), *W = malloc(n * 8);
+    for (int64_t i = 0; i < n * n * 2; ++i) G[i] = frand();
+    /* A = G G^H + n I (interleaved complex, column-major) */
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i < n; ++i) {
+        double re = (i == j) ? (double)n : 0.0, im = 0.0;
+        for (int64_t k = 0; k < n; ++k) {
+          double gr1 = G[2 * (i + k * n)], gi1 = G[2 * (i + k * n) + 1];
+          double gr2 = G[2 * (j + k * n)], gi2 = G[2 * (j + k * n) + 1];
+          re += gr1 * gr2 + gi1 * gi2;
+          im += gi1 * gr2 - gr1 * gi2;
+        }
+        A[2 * (i + j * n)] = re;
+        A[2 * (i + j * n) + 1] = im;
+      }
+    double *As = malloc(n * n * 16);
+    memcpy(As, A, n * n * 16);
+    for (int64_t i = 0; i < n * 2; ++i) Bs[i] = B[i] = frand();
+    int info = slate_zposv('l', n, 1, A, n, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n; ++i) {
+      double accr = -Bs[2 * i], acci = -Bs[2 * i + 1];
+      for (int64_t k = 0; k < n; ++k) {
+        double ar = As[2 * (i + k * n)], ai = As[2 * (i + k * n) + 1];
+        double xr = B[2 * k], xi = B[2 * k + 1];
+        accr += ar * xr - ai * xi;
+        acci += ar * xi + ai * xr;
+      }
+      double d = fabs(accr) + fabs(acci);
+      if (d > maxe) maxe = d;
+    }
+    fails += check("zposv", maxe, 1e-9);
+    /* eigenvalues of an HPD matrix are positive; trace check */
+    info = slate_zheev('n', 'l', n, As, n, W);
+    double tr = 0, wsum = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      tr += A[0] * 0;  /* quiet unused warnings pattern */
+      wsum += W[i];
+    }
+    for (int64_t i = 0; i < n; ++i) tr += As[2 * (i + i * n)];
+    maxe = info == 0 ? fabs(tr - wsum) / fabs(tr) : 1e9;
+    for (int64_t i = 0; i < n; ++i)
+      if (info == 0 && W[i] <= 0) maxe = 1e9;    /* HPD: all positive */
+    fails += check("zheev", maxe, 1e-10);
+    free(A); free(As); free(G); free(B); free(Bs); free(W);
+  }
+
+  /* c-precision handle round trip: create_c -> read_c preserves data */
+  {
+    float *D = malloc(n * n * 8), *O = malloc(n * n * 8);
+    for (int64_t i = 0; i < n * n * 2; ++i) D[i] = (float)frand();
+    int64_t h = slate_matrix_create_c(n, n, D, n);
+    int rc = slate_matrix_read_c(h, O, n);
+    double maxe = (h > 0 && rc == 0) ? 0 : 1e9;
+    for (int64_t i = 0; i < n * n * 2; ++i) {
+      double d = fabs((double)O[i] - (double)D[i]);
+      if (d > maxe) maxe = d;
+    }
+    fails += check("h-cmplx", maxe, 0.0);
+    slate_matrix_destroy(h);
+    free(D); free(O);
+  }
+
+  /* spbsv: single-precision band SPD + the undersized-ldab guard */
+  {
+    const int64_t kd = 2, ldab = kd + 1;
+    float *AB = calloc(ldab * n, 4), *Af = calloc(n * n, 4);
+    float *B = malloc(n * 4), *Bs = malloc(n * 4);
+    for (int64_t j = 0; j < n; ++j) {
+      AB[0 + j * ldab] = 4.0f * (kd + 1);
+      Af[j + j * n] = AB[0 + j * ldab];
+      for (int64_t d = 1; d <= kd && j + d < n; ++d) {
+        float v = (float)frand();
+        AB[d + j * ldab] = v;
+        Af[(j + d) + j * n] = v;
+        Af[j + (j + d) * n] = v;
+      }
+    }
+    for (int64_t i = 0; i < n; ++i) Bs[i] = B[i] = (float)frand();
+    int info = slate_spbsv('l', n, kd, 1, AB, ldab, B, n);
+    double maxe = info == 0 ? 0 : 1e9;
+    for (int64_t i = 0; i < n; ++i) {
+      double acc = 0;
+      for (int64_t k = 0; k < n; ++k) acc += (double)Af[i + k * n] * B[k];
+      if (fabs(acc - Bs[i]) > maxe) maxe = fabs(acc - Bs[i]);
+    }
+    fails += check("spbsv", maxe, 1e-4);
+    fails += check("pbsv-ld", slate_spbsv('l', n, kd, 1, AB, kd, B, n) == -6
+                   ? 0 : 1, 0.5);
+    free(AB); free(Af); free(B); free(Bs);
   }
 
   /* band SPD: dpbsv on LAPACK lower band storage */

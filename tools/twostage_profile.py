@@ -1,0 +1,99 @@
+"""Phase profile of the distributed two-stage eigensolver on the virtual
+mesh (VERDICT r3 #4: "attack the distributed two-stage constants — profile
+where it goes: the chase? the merge secular iters? collective
+serialization?").
+
+Times each phase of heev_distributed(n, 2x4 virtual CPU mesh) separately
+with block_until_ready fences.  Virtual-mesh wall clock can NEVER show
+distributed speedup (8 'devices' share the same cores — round-3 memory
+note); what it CAN show is the phase SPLIT, which is what directs the fix.
+
+Usage: python tools/twostage_profile.py [n]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from force_cpu import force_cpu_backend
+
+force_cpu_backend(virtual_devices=8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fence(x):
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, x)
+    return x
+
+
+def timed(label, fn, *args, **kw):
+    t0 = time.perf_counter()
+    out = fence(fn(*args, **kw))
+    t1 = time.perf_counter()   # includes compile on first call — rerun below
+    t2 = time.perf_counter()
+    out = fence(fn(*args, **kw))
+    t3 = time.perf_counter()
+    print(f"{label:28s} first={t1 - t0:8.2f}s  steady={t3 - t2:8.2f}s",
+          flush=True)
+    return out, t3 - t2
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    from slate_tpu.parallel import ProcessGrid
+    from slate_tpu.parallel.eig_dist import (he2hb_distributed,
+                                             unmtr_he2hb_distributed)
+    from slate_tpu.parallel.summa import gemm_padded
+    from slate_tpu.linalg.eig import hb2st, sterf
+    from slate_tpu.linalg.stedc import stedc
+
+    grid = ProcessGrid(2, 4)
+    rng = np.random.default_rng(0)
+    m = rng.standard_normal((n, n)).astype(np.float64)
+    a = jnp.asarray((m + m.T) / 2)
+    nb = max(2, min(64, -(-n // (4 * 8))))
+    print(f"n={n} nb={nb} grid=2x4 (virtual)", flush=True)
+
+    (band, Vs, Ts), t1 = timed("stage1 he2hb_distributed",
+                               lambda x: he2hb_distributed(x, grid, nb=nb), a)
+    band_r = jax.device_put(band, grid.replicated())
+
+    (d, e, Q2), t2 = timed("stage2 hb2st (+vectors)",
+                           lambda b: hb2st(b, kd=nb, want_vectors=True,
+                                           pipeline=False), band_r)
+    _, t2p = timed("stage2 hb2st (pipelined)",
+                   lambda b: hb2st(b, kd=nb, want_vectors=True,
+                                   pipeline=True), band_r)
+    _, t3v = timed("sterf (values only)", sterf, d, e)
+    (lam, Zt), t3 = timed("stedc (dist merges)",
+                          lambda dd, ee: stedc(dd, ee, grid=grid), d, e)
+    (Z,), t4 = timed("back-transform Q2@Zt",
+                     lambda q, z: (gemm_padded(q, z.astype(q.dtype), grid),),
+                     Q2, Zt)
+    (Zf,), t5 = timed("back-transform unmtr",
+                      lambda v, t, z: (unmtr_he2hb_distributed(
+                          v, t, z, grid, conj_q=False),), Vs, Ts, Z)
+    total = t1 + t2 + t3 + t4 + t5
+    print(f"\nsteady-state total (vectors, DC): {total:.2f}s")
+    for label, t in [("stage1", t1), ("chase", t2), ("stedc", t3),
+                     ("Q2 gemm", t4), ("unmtr", t5)]:
+        print(f"  {label:10s} {t:8.2f}s  {100 * t / total:5.1f}%")
+    print(f"  (pipelined chase alternative: {t2p:.2f}s; "
+          f"values-only sterf: {t3v:.2f}s)")
+
+    # correctness spot check
+    T = np.asarray(a)
+    ref = np.linalg.eigvalsh(T)
+    err = np.max(np.abs(np.sort(np.asarray(lam)) - ref)) / np.max(np.abs(ref))
+    print(f"eigenvalue rel err vs eigvalsh: {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()
