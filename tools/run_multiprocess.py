@@ -182,6 +182,23 @@ def worker(coord: str, pid: int) -> None:
             blk, Xhref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]], atol=1e-2)
     print(f"worker {pid}: hesv OK", flush=True)
 
+    # --- 7) round-4: distributed RBT solve (sharded butterfly + nopiv LU +
+    # IR — its psums/trsm partitions are process-count agnostic like the
+    # rest) across the two-process boundary
+    from slate_tpu.parallel import gesv_rbt_distributed
+
+    Gm = rng.standard_normal((m, m)).astype(np.float32)
+    Xr, infor, _ = gesv_rbt_distributed(jnp.asarray(Gm), jnp.asarray(Bh),
+                                        grid, depth=2, nb=8)
+    Xrref = np.linalg.solve(Gm, Bh)
+    for shard in Xr.addressable_shards:
+        r0, c0 = (sl.start or 0 for sl in shard.index)
+        blk = np.asarray(shard.data)
+        np.testing.assert_allclose(
+            blk, Xrref[r0:r0 + blk.shape[0], c0:c0 + blk.shape[1]],
+            atol=1e-2)
+    print(f"worker {pid}: rbt OK", flush=True)
+
     jax.distributed.shutdown()
     print(f"worker {pid}: OK", flush=True)
 
