@@ -339,6 +339,39 @@ def run_gesv_rbt(p, slate):
     return _result(p, err, 2 * n ** 3 / 3, t)
 
 
+@_routine("gesv_f64ir", "lu")
+def run_gesv_f64ir(p, slate):
+    """Emulated-f64 IR solve (ops/f64emu.py): f32 factor + exact-Ozaki
+    residuals; the tester's d rows verify double-class forward error on
+    hardware without f64 ALUs (gate scaled to the emulation envelope, not
+    the f32 eps the suite-wide tolerance assumes)."""
+    import jax.numpy as jnp
+
+    from slate_tpu.ops.f64emu import gesv_f64ir
+
+    n = p["n"]
+    A = _gen(p["kind"], n, n, p) + n * np.eye(n, dtype=p["dtype"])
+    if np.iscomplexobj(A):
+        b = _gen("randn", n, 1, p) + 1j * _gen("randn", n, 1, p)
+    else:
+        b = _gen("randn", n, 1, p)
+    (Xh, Xl, iters, info), t = time_call(
+        lambda: gesv_f64ir(jnp.asarray(A), jnp.asarray(b)),
+        repeat=p["repeat"])
+    x = np.asarray(Xh, np.complex128 if np.iscomplexobj(A) else np.float64) \
+        + np.asarray(Xl, np.complex128 if np.iscomplexobj(A) else np.float64)
+    err = _rel(np.linalg.norm(A.astype(x.dtype) @ x - b),
+               np.linalg.norm(A) * np.linalg.norm(x))
+    out = _result(p, err, 2 * n ** 3 / 3, t)
+    # double-class gate: orders below f32 eps (the dtype-derived suite
+    # tolerance would under-test the emulation)
+    strict = 1e-9 * max(1.0, n ** 0.5)
+    out["status"] = "pass" if err is not None and err <= strict else "FAILED"
+    out["message"] = "" if out["status"] == "pass" \
+        else f"err>{strict:.1e} (double-class gate)"
+    return out
+
+
 @_routine("hesv", "indefinite")
 def run_hesv(p, slate):
     n = p["n"]
