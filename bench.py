@@ -217,10 +217,15 @@ def child_potrf(cpu_fallback):
     # panel steps and crawls at large n on TPU; the framework's right-looking
     # blocked factorization keeps the trailing updates as big MXU gemms —
     # the reason SLATE-style blocking exists (potrf.cc:84-195).
-    # BENCH_POTRF_NB overrides for on-chip block-size sweeps.
+    # BENCH_POTRF_NB overrides for on-chip block-size sweeps;
+    # BENCH_POTRF_INVTRSM=1 selects the inverse-apply panel variant
+    # (Options.trsm_via_inverse) and marks the metric accordingly so the
+    # sweep rows never conflate with the true-trsm baseline.
     import os as _os
+    inv = _os.environ.get("BENCH_POTRF_INVTRSM") == "1"
     opts = {"target": "tiled",
-            "block_size": int(_os.environ.get("BENCH_POTRF_NB", 2048))}
+            "block_size": int(_os.environ.get("BENCH_POTRF_NB", 2048)),
+            "trsm_via_inverse": inv}
 
     def body(i, c, a):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
@@ -228,7 +233,8 @@ def child_potrf(cpu_fallback):
 
     gflops, per_iter = _chain_rate(body, a, (a,), 1, 3, n**3 / 3.0,
                                    repeats=2)
-    _emit({"metric": f"potrf_f32_n{n}_gflops", "value": round(gflops, 1),
+    tag = "_invtrsm" if inv else ""
+    _emit({"metric": f"potrf{tag}_f32_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
 
 

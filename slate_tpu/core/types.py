@@ -249,6 +249,10 @@ class Options:
     pivot_threshold: float = 1.0    # Option::PivotThreshold
     depth: int = 2                  # Option::Depth (RBT butterfly depth, gesv_rbt.cc)
     target: Target = Target.Auto
+    trsm_via_inverse: bool = False  # tiled potrf panel: apply Lkk^{-1} as a
+                                    # gemm instead of TriangularSolve (pure
+                                    # MXU throughput for ~cond(Lkk)^2 local
+                                    # error; bench sweep knob, linalg/chol.py)
     hold_local_workspace: bool = False  # parity only
     print_verbose: int = 0          # Option::PrintVerbose (enums.hh:477-488)
     print_edgeitems: int = 16

@@ -133,8 +133,17 @@ def _chol_blocked(a):
 
 
 @lru_cache(maxsize=32)
-def _potrf_tiled_fn(n: int, nb: int, dtype_str: str):
-    """Build + jit the blocked right-looking factorization for static (n, nb)."""
+def _potrf_tiled_fn(n: int, nb: int, dtype_str: str, inv_trsm: bool = False):
+    """Build + jit the blocked right-looking factorization for static (n, nb).
+
+    ``inv_trsm``: replace the panel TriangularSolve with an explicit
+    inverse-apply — Linv = Lkk^{-1} once per step (one nb-wide solve), then
+    panel = A21 · Linv^H as a full-rate MXU gemm.  TriangularSolve's internal
+    blocking serializes against the MXU at large nb; the inverse-apply trades
+    ~cond(Lkk)² local error amplification (fine for the f32 bench envelope)
+    for pure gemm throughput — the classical GPU-library trsm trick, selected
+    via ``Options.trsm_via_inverse`` (bench.py's potrf child maps the
+    ``BENCH_POTRF_INVTRSM=1`` sweep env var onto it)."""
 
     nt = -(-n // nb)
 
@@ -150,9 +159,17 @@ def _potrf_tiled_fn(n: int, nb: int, dtype_str: str):
                 # panel trsm (≅ internal::trsm over the panel, potrf.cc:115-119);
                 # the panel "broadcast" (tileBcast, potrf.cc:109) is implicit: XLA
                 # inserts the all-gather when the operands are sharded.
-                panel = lax.linalg.triangular_solve(
-                    Lkk, L[k1:n, k0:k1], left_side=False, lower=True,
-                    conjugate_a=True, transpose_a=True)
+                if inv_trsm:
+                    eye_b = jnp.eye(k1 - k0, dtype=L.dtype)
+                    Linv = lax.linalg.triangular_solve(
+                        Lkk, eye_b, left_side=True, lower=True)
+                    panel = jnp.matmul(L[k1:n, k0:k1],
+                                       jnp.conj(Linv.T),
+                                       precision=lax.Precision.HIGHEST)
+                else:
+                    panel = lax.linalg.triangular_solve(
+                        Lkk, L[k1:n, k0:k1], left_side=False, lower=True,
+                        conjugate_a=True, transpose_a=True)
                 L = L.at[k1:n, k0:k1].set(panel)
                 # trailing update (≅ internal::herk, potrf.cc:136-148 — the hot loop).
                 # Full-width update keeps the trailing block Hermitian so later panels
@@ -195,7 +212,8 @@ def potrf(A, opts=None, uplo=None):
         elif target == Target.XLA:
             L = jnp.tril(lax.linalg.cholesky(Af))
         else:
-            L = _potrf_tiled_fn(n, min(opts.block_size, n), str(Af.dtype))(Af)
+            L = _potrf_tiled_fn(n, min(opts.block_size, n), str(Af.dtype),
+                                inv_trsm=opts.trsm_via_inverse)(Af)
     info = _chol_info(L)
     if opts.exact_info and int(info) != 0:
         # opt-in host refinement: XLA's Cholesky NaN-fills the whole factor, so

@@ -31,6 +31,9 @@ for i in $(seq 1 400); do
       BENCH_POTRF_NB=$nb timeout 1200 \
         python bench.py --child potrf 2>&1 | tail -1
     done
+    echo "[sweep] potrf inverse-apply panel"
+    BENCH_POTRF_INVTRSM=1 timeout 1200 \
+      python bench.py --child potrf 2>&1 | tail -1
     for nb in 1024 4096; do
       echo "[sweep] potrf_la nb=$nb"
       BENCH_POTRF_LA_NB=$nb timeout 1200 \
