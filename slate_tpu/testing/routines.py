@@ -372,6 +372,33 @@ def run_gesv_f64ir(p, slate):
     return out
 
 
+@_routine("posv_f64ir", "chol")
+def run_posv_f64ir(p, slate):
+    """SPD sibling of gesv_f64ir: f32 Cholesky + emulated-f64 refinement
+    (ops/f64emu.posv_f64ir), same double-class gate."""
+    import jax.numpy as jnp
+
+    from slate_tpu.ops.f64emu import posv_f64ir
+
+    n = p["n"]
+    G = _gen(p["kind"], n, n, p)
+    A = G @ np.conj(G.T) + n * np.eye(n, dtype=p["dtype"])
+    b = _gen("randn", n, 1, p)
+    (Xh, Xl, iters, info), t = time_call(
+        lambda: posv_f64ir(jnp.asarray(A), jnp.asarray(b)),
+        repeat=p["repeat"])
+    wide = np.complex128 if np.iscomplexobj(A) else np.float64
+    x = np.asarray(Xh, wide) + np.asarray(Xl, wide)
+    err = _rel(np.linalg.norm(A.astype(wide) @ x - b),
+               np.linalg.norm(A) * np.linalg.norm(x))
+    out = _result(p, err, n ** 3 / 3, t)
+    strict = 1e-9 * max(1.0, n ** 0.5)
+    out["status"] = "pass" if err is not None and err <= strict else "FAILED"
+    out["message"] = "" if out["status"] == "pass" \
+        else f"err>{strict:.1e} (double-class gate)"
+    return out
+
+
 @_routine("hesv", "indefinite")
 def run_hesv(p, slate):
     n = p["n"]
