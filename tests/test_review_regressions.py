@@ -64,3 +64,57 @@ def test_tb2bd_kd_two(rng):
     s, _, _ = slate.bdsqr(d, e)
     np.testing.assert_allclose(np.sort(np.asarray(s))[::-1],
                                np.linalg.svd(T, compute_uv=False), atol=1e-10)
+
+
+def test_norm_blocks_capped_in_bytes():
+    """Round-4 review: _BM is sized for f32; wider dtypes must scale the
+    row block down so a double-buffered block stays inside the ~16 MB VMEM
+    budget (f64 at the f32 block shape would need 16 MB for buffers alone)."""
+    import jax.numpy as jnp
+    from slate_tpu.ops.pallas_norms import _blocks, _BM
+
+    bm32, bn32 = _blocks(4096, 4096, jnp.float32)
+    bm64, _ = _blocks(4096, 4096, jnp.float64)
+    bmc128, _ = _blocks(4096, 4096, jnp.complex128)
+    assert bm32 == _BM
+    assert bm64 == _BM // 2
+    assert bmc128 == _BM // 4
+    assert bn32 % 128 == 0
+
+
+def test_bdsqr_bisect_with_vectors_rejected(rng):
+    """Round-4 review: method='bisect' is values-only; silently remapping to
+    the dense path would defeat a caller bounding memory/time."""
+    d = np.abs(rng.standard_normal(16)) + 1
+    e = rng.standard_normal(15) * 0.1
+    with pytest.raises(slate.SlateError):
+        slate.bdsqr(d, e, want_vectors=True, method="bisect")
+
+
+def test_complex_sysv_not_exposed_in_lapack_skin():
+    """Round-4 review: LAPACK csysv/zsysv solve complex SYMMETRIC systems;
+    the backend's Aasen is Hermitian — exposing the names would silently
+    factor the conjugate-mirrored matrix."""
+    import slate_tpu.lapack_api as l
+
+    assert hasattr(l, "dsysv") and hasattr(l, "zhesv")
+    assert not hasattr(l, "zsysv") and not hasattr(l, "csysv")
+
+
+def test_gesv_rbt_grid_honors_tolerance(rng):
+    """Round-4 review: opts.tolerance must reach the distributed IR loop
+    (it was silently dropped on the grid path)."""
+    from slate_tpu.parallel import ProcessGrid
+
+    n = 48
+    A = rng.standard_normal((n, n))
+    Xt = rng.standard_normal((n, 2))
+    B = A @ Xt
+    M = slate.Matrix.from_array(np.asarray(A), grid=ProcessGrid(2, 4))
+    X, info, iters = slate.gesv_rbt(M, np.asarray(B),
+                                    opts={"block_size": 16,
+                                          "tolerance": 1e-2})
+    # a loose tolerance converges immediately; the default eps-scale one
+    # takes >= 1 refinement round
+    assert int(iters) <= 1
+    assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-2
