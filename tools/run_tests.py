@@ -57,6 +57,9 @@ SIZE_CLASSES = {
     "small":  {"dims": [256], "nb": [64], "nrhs": 8},
     "medium": {"dims": [512, 768], "nb": [128], "nrhs": 16},
     "large":  {"dims": [1024, 2048], "nb": [256], "nrhs": 16},
+    # BASELINE-direction scale row: constant-factor data beyond the pytest
+    # pin (the virtual mesh measures constants, not speedup — PERF_CPU.md)
+    "xlarge": {"dims": [4096], "nb": [256], "nrhs": 16},
 }
 
 
