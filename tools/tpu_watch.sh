@@ -39,6 +39,8 @@ for i in $(seq 1 400); do
       BENCH_POTRF_LA_NB=$nb timeout 1200 \
         python bench.py --child potrf_la 2>&1 | tail -1
     done
+    echo "[profile] potrf jax.profiler trace"
+    timeout 1200 python tools/tpu_profile_potrf.py 2>&1 | tail -2
     echo "[tpu_watch] all done ($(date -u +%H:%M:%S))"
     exit 0
   fi
