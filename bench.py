@@ -544,9 +544,29 @@ def child_heev2s(cpu_fallback):
     gflops, sec = _direct_rate(run, make_input,
                                lambda r: float(r.ravel()[0]),
                                4.0 * n**3 / 3.0, repeats=2)
+
+    # phase split (heev.cc:126-212's timer-level-2 analogue): time each
+    # stage once, fetch-forced, so a single chip capture carries the
+    # he2hb / hb2st / sterf breakdown alongside the end-to-end rate
+    from slate_tpu.linalg.eig import hb2st, he2hb, sterf
+
+    phases = {}
+    t0 = time.perf_counter()
+    band, Vs, Ts = he2hb(a)
+    float(band.ravel()[0])
+    phases["he2hb_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    d, e = hb2st(band, want_vectors=False, pipeline=not cpu_fallback)
+    float(d.ravel()[0])
+    phases["hb2st_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    lam = sterf(d, e)
+    float(lam.ravel()[0])
+    phases["sterf_s"] = round(time.perf_counter() - t0, 3)
+
     _emit({"metric": f"heev_two_stage_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
-           "sec_per_call": sec})
+           "sec_per_call": sec, "phases_first_call": phases})
 
 
 CHILDREN = {
