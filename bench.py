@@ -79,6 +79,10 @@ BASELINES = {
                           # ours = f32 LU + emulated-f64 IR to double-class
                           # forward error (gesv_f64ir), flops on the 2n^3/3
                           # dgetrf model
+    "svd2s": 150.0,       # dgesvd values n=8192 published-order estimate
+                          # (between the n=4096 100 and n=16384 200 rates);
+                          # times the SLATE-parity SVD pipeline next to the
+                          # fused default
     "heev2s": 225.0,      # dsyevd values n=8192 published-order estimate
                           # (between the n=4096 150 and n=16384 300 rates);
                           # config exists to time the SLATE-parity two-stage
@@ -91,14 +95,14 @@ BASELINES = {
 # and tournament paths are slow enough at n=16384 to risk the per-config
 # timeout)
 CONFIGS = ["gemm", "norm", "f64gemm", "potrf", "potrf_la", "gels", "gesvir",
-           "heev", "svd", "getrf", "heev2s"]
+           "heev", "svd", "getrf", "heev2s", "svd2s"]
 HEADLINE = "gemm"
 
 # per-config child timeouts: the BASELINE-scale eig/SVD configs and the
 # 64-panel two-level CALU carry minutes of (remote) XLA compile before the
 # first timed call — measured 3 min of compile for the getrf program on CPU
 CONFIG_TIMEOUTS = {"heev": 1300, "svd": 1500, "getrf": 1500,
-                   "potrf_la": 1300, "heev2s": 1800}
+                   "potrf_la": 1300, "heev2s": 1800, "svd2s": 1800}
 
 # ---------------------------------------------------------------------------
 # children — each runs in its own process, imports jax lazily
@@ -569,6 +573,50 @@ def child_heev2s(cpu_fallback):
            "sec_per_call": sec, "phases_first_call": phases})
 
 
+def child_svd2s(cpu_fallback):
+    """Singular values via the SLATE-parity two-stage pipeline (ge2tb ->
+    tb2bd -> Golub–Kahan bisection, linalg/svd.py method='two_stage') at
+    n=8192 — timed next to the fused-QDWH default, with the ge2tb/tb2bd/
+    bdsqr phase split in the record (svd.cc:270-304 timer analogue)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 512 if cpu_fallback else 8192
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), dtype=jnp.float32)
+
+    import slate_tpu
+
+    def run(x):
+        S, _, _ = slate_tpu.svd(x, want_u=False, want_vt=False,
+                                method="two_stage",
+                                chase_pipeline=not cpu_fallback)
+        return S
+
+    def make_input(j):
+        return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
+
+    gflops, sec = _direct_rate(run, make_input,
+                               lambda r: float(r.ravel()[0]),
+                               8.0 * n**3 / 3.0, repeats=2)
+
+    from slate_tpu.linalg.svd import bdsqr, ge2tb, tb2bd
+
+    phases = {}
+    t0 = time.perf_counter()
+    d, e, _, _ = ge2tb(a, chase_pipeline=not cpu_fallback)
+    float(d.ravel()[0])
+    phases["ge2tb_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    S, _, _ = bdsqr(d, e)
+    float(S.ravel()[0])
+    phases["bdsqr_s"] = round(time.perf_counter() - t0, 3)
+
+    _emit({"metric": f"svd_two_stage_f32_n{n}_gflops",
+           "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
+           "sec_per_call": sec, "phases_first_call": phases})
+
+
 CHILDREN = {
     "probe": lambda cpu: child_probe(),
     "norm": child_norm,
@@ -582,6 +630,7 @@ CHILDREN = {
     "f64gemm": child_f64gemm,
     "gesvir": child_gesvir,
     "heev2s": child_heev2s,
+    "svd2s": child_svd2s,
 }
 
 
