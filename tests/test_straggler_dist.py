@@ -504,3 +504,17 @@ class TestRbtDist:
         X, info, iters = slate.gesv_rbt(M, jnp.asarray(B),
                                         opts={"block_size": 16})
         assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10
+
+    def test_gesv_rbt_distributed_complex(self, grid24, rng):
+        """Complex systems ride the same sharded butterfly + nopiv pipeline
+        (the butterfly diagonals are real positive, cast into the dtype)."""
+        from slate_tpu.parallel import gesv_rbt_distributed
+
+        n = 96
+        A = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        Xt = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+        B = A @ Xt
+        X, info, iters = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B),
+                                              grid24, depth=2, nb=16)
+        assert int(info) == 0
+        assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10
