@@ -15,7 +15,7 @@ for i in $(seq 1 400); do
     BENCH_DEADLINE_SEC=3000 timeout 3200 python bench.py --only heev,svd 2>&1 | tail -1
     echo "[tpu_watch] heev/svd done ($(date -u +%H:%M:%S))"
     # (c) the round-4 additions: lookahead potrf, f64 story, two-stage timing
-    BENCH_DEADLINE_SEC=5400 timeout 5700 python bench.py --only potrf_la,f64gemm,gesvir,heev2s,svd2s 2>&1 | tail -1
+    BENCH_DEADLINE_SEC=7000 timeout 7300 python bench.py --only potrf_la,f64gemm,gesvir,heev2s,svd2s 2>&1 | tail -1
     echo "[tpu_watch] r4 configs done ($(date -u +%H:%M:%S))"
     # (d) refresh the five round-3 captures
     BENCH_DEADLINE_SEC=2400 timeout 2700 python bench.py --only gemm,norm,potrf,gels 2>&1 | tail -1
