@@ -87,7 +87,7 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
         lam, z = heev_distributed(
             a, grid, nb=default_band_nb(n, opts),
             want_vectors=want_vectors,
-            method_eig="dc" if opts.method_eig == MethodEig.DC else "qr",
+            method_eig="qr" if opts.method_eig == MethodEig.QR else "dc",
             chase_pipeline=chase_pipeline)
         return (lam, z) if want_vectors else (lam, None)
     if method == "two_stage" and n < 8:
@@ -105,10 +105,13 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
             with timers.time("heev::stev"):
                 if want_vectors:
                     d, e, Q2 = out
-                    if opts.method_eig == MethodEig.DC:
-                        lam, Zt = stedc(d, e)
-                    else:
+                    if opts.method_eig == MethodEig.QR:
+                        # explicit QR-iteration request (O(n²)·gemm sweeps —
+                        # the compatibility method, like the reference)
                         lam, Zt = steqr(d, e)
+                    else:
+                        # Auto/DC: divide & conquer, the performance path
+                        lam, Zt = stedc(d, e)
                     with timers.time("heev::unmtr_hb2st"):
                         z = jnp.matmul(Q2, Zt.astype(Q2.dtype),
                                        precision=lax.Precision.HIGHEST)
@@ -651,20 +654,18 @@ def sterf(d, e, opts=None):
 
 def steqr(d, e, Z: Optional[jax.Array] = None, opts=None):
     """Tridiagonal QR iteration with optional eigenvector accumulation
-    (src/steqr.cc distributes the Z update).  Small problems use one fused
-    eigh; at BASELINE scale the dense eigh is the wrong complexity class, so
-    large n routes to the D&C solver whose merges are MXU gemms
-    (linalg/stedc.py) — same (ascending lam, Z @ Q) contract."""
-    d = jnp.asarray(d)
-    if d.shape[-1] > _STEV_DENSE_MAX:
-        from .stedc import stedc as _stedc_impl
+    (src/steqr.cc; same (ascending lam, Z @ Q) contract as stedc).
 
-        return _stedc_impl(d, e, Z, opts)
-    lam, Q = jnp.linalg.eigh(_assemble_tridiag(d, e))
-    if Z is not None:
-        Q = jnp.matmul(Z.astype(Q.dtype) if Z.dtype != Q.dtype else Z, Q,
-                       precision=lax.Precision.HIGHEST)
-    return lam, Q
+    This is REAL implicit-shift QR iteration at every size — masked-window
+    sweeps under one while_loop, each sweep's Givens chain applied to Z as a
+    single MXU gemm (``linalg/steqr_qr.py``; the distributed form shards Z's
+    rows, ``parallel.steqr_distributed``).  MethodEig.QR therefore means QR
+    iteration semantics everywhere; the performance default for large
+    vectors problems remains stedc (MethodEig.Auto/DC), the same split the
+    reference makes."""
+    from .steqr_qr import steqr_qr
+
+    return steqr_qr(d, e, Z)
 
 
 def stedc(d, e, Z: Optional[jax.Array] = None, opts=None):

@@ -306,7 +306,7 @@ def ge2tb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
 
 
 def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
-                     want_vectors: bool = True, method_eig: str = "qr",
+                     want_vectors: bool = True, method_eig: str = "dc",
                      chase_pipeline: bool = False):
     """Distributed Hermitian eigensolve over the (p, q) mesh (src/heev.cc).
 
@@ -315,7 +315,6 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     """
     from ..linalg.eig import _safe_scale, hb2st, sterf
     from ..linalg.stedc import stedc as _stedc
-    from ..linalg.eig import steqr
 
     n = A.shape[-1]
     if n < 8:
@@ -350,7 +349,9 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         # distributed D&C: the merge basis-update gemms ride the mesh
         lam, Zt = _stedc(d, e, grid=grid)
     else:
-        lam, Zt = steqr(d, e)
+        # MethodEig.QR: real QR iteration with the Z update sharded over
+        # mesh rows (steqr.cc's 1-D redistribute + local-row rotations)
+        lam, Zt = steqr_distributed(d, e, grid)
     # chase back-transform is the same O(n³) order as the merges — it rides
     # the mesh too rather than replicating on every device
     from .summa import gemm_padded
@@ -360,6 +361,46 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     # block; unmtr_he2hb.cc)
     Z = unmtr_he2hb_distributed(Vs, Ts, Z, grid, conj_q=False)
     return lam * factor, Z
+
+
+@lru_cache(maxsize=16)
+def _steqr_shard_fn(mesh):
+    """Row-sharded tridiagonal QR iteration (src/steqr.cc:52-82).
+
+    The reference redistributes Z into a 1-D row layout, every rank runs the
+    identical host QR iteration on the replicated (D, E) scalars, and each
+    rank applies the plane rotations to its local rows only.  Here: the
+    (d, e) while_loop replays identically on every device inside shard_map
+    (deterministic, so every shard sees the same rotation chain) and each
+    device absorbs each sweep into its (npad/nproc, n) row block with a
+    local MXU gemm.  The compiled module contains ZERO collectives — row
+    parallelism is the whole story, exactly the reference's design point.
+    """
+    from ..linalg.steqr_qr import steqr_qr
+
+    def local_fn(d, e, z_loc):
+        return steqr_qr(d, e, z_loc)
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(None), P(None), P(AX, None)),
+                       out_specs=(P(None), P(AX, None)), check_vma=False)
+    return jax.jit(fn)
+
+
+def steqr_distributed(d, e, grid: ProcessGrid, Z=None):
+    """Distributed steqr: eigenvalues replicated, eigenvector matrix returned
+    row-sharded on the flattened mesh.  ``Z`` (optional) is the matrix to
+    accumulate into (defaults to identity, yielding Q itself)."""
+    d = jnp.asarray(d)
+    n = d.shape[0]
+    nproc = grid.p * grid.q
+    Z0 = jnp.eye(n, dtype=d.dtype) if Z is None else jnp.asarray(Z)
+    m = Z0.shape[0]
+    npad = -(-m // nproc) * nproc
+    if npad != m:
+        Z0 = jnp.pad(Z0, ((0, npad - m), (0, 0)))
+    lam, Zo = _steqr_shard_fn(grid.mesh)(d, jnp.asarray(e), Z0)
+    return lam, Zo[:m]
 
 
 def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
