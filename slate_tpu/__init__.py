@@ -20,9 +20,10 @@ from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
                    TileKind, TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
                    Uplo, func)
 
-from .blas import (add, col_norms, copy, gemm, hemm, her2k, herk, norm, scale,
-                   scale_row_col, set, set_from_function, set_lambdas, symm,
-                   syr2k, syrk, trmm, trsm)
+from .blas import (add, col_norms, copy, gemm, gemmA, gemmC, hemm, hemmA,
+                   hemmC, her2k, herk, norm, scale, scale_row_col, set,
+                   set_from_function, set_lambdas, symm, syr2k, syrk, trmm,
+                   trsm, trsmA, trsmB)
 from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band, gecondest,
                      gelqf, gels, gels_cholqr, gels_qr, geqrf, gerbt, gesv,
                      gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf,
@@ -33,7 +34,8 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      potrs, stedc, stedc_deflate, stedc_merge, stedc_secular,
                      stedc_solve, stedc_sort, stedc_z_vector, steqr, steqr2,
                      sterf, svd, svd_vals, syev, sygst, sygv, sysv, sytrf,
-                     sytrs, tb2bd, tbsm, trcondest, trtri, trtrm, unmbr_ge2tb,
+                     sytrs, tb2bd, tbsm, tbsm_pivots, tbsmPivots, trcondest,
+                     trtri, trtrm, unmbr_ge2tb,
                      unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)
 from . import simplified
 from . import matgen

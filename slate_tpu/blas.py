@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from .core.exceptions import SlateError, slate_assert
 from .core.matrix import (BaseBandMatrix, BaseMatrix, BaseTrapezoidMatrix,
                           HermitianMatrix, SymmetricMatrix, as_array, write_back)
-from .core.types import (Diag, MethodGemm, Norm, NormScope, Options, Side, Uplo)
+from .core.types import (Diag, MethodGemm, MethodTrsm, Norm, NormScope,
+                         Options, Side, Uplo)
 from .ops import blas3, elementwise, norms as norm_ops
 
 
@@ -98,6 +99,27 @@ def gemm(alpha, A, B, beta, C, opts=None):
     return write_back(C, out)
 
 
+def gemmA(alpha, A, B, beta, C, opts=None):
+    """Stationary-A gemm (src/gemmA.cc): A's tiles stay put, partial C
+    products are reduced to C's owners — the reference's pick for a B with
+    one block column (select_algo, src/gemm.cc:12-24).  On one device the
+    stationarity distinction is a communication layout, not a kernel: both
+    variants are the same fused MXU matmul."""
+    from dataclasses import replace
+
+    opts = replace(Options.make(opts), method_gemm=MethodGemm.A)
+    return gemm(alpha, A, B, beta, C, opts)
+
+
+def gemmC(alpha, A, B, beta, C, opts=None):
+    """Stationary-C gemm (src/gemmC.cc): C never moves, A panels are
+    broadcast — the wide-B default."""
+    from dataclasses import replace
+
+    opts = replace(Options.make(opts), method_gemm=MethodGemm.C)
+    return gemm(alpha, A, B, beta, C, opts)
+
+
 def symm(side, alpha, A, B, beta, C, opts=None, uplo=None):
     """C = alpha A B + beta C, A symmetric (src/symm.cc)."""
     out = blas3.symm(side, alpha, as_array(A), _uplo_of(A, uplo),
@@ -110,6 +132,17 @@ def hemm(side, alpha, A, B, beta, C, opts=None, uplo=None):
     out = blas3.hemm(side, alpha, as_array(A), _uplo_of(A, uplo),
                      as_array(B), beta, as_array(C))
     return write_back(C, out)
+
+
+def hemmA(side, alpha, A, B, beta, C, opts=None, uplo=None):
+    """Stationary-A Hermitian multiply (src/hemmA.cc); see gemmA for the
+    stationarity semantics on TPU."""
+    return hemm(side, alpha, A, B, beta, C, opts=opts, uplo=uplo)
+
+
+def hemmC(side, alpha, A, B, beta, C, opts=None, uplo=None):
+    """Stationary-C Hermitian multiply (src/hemmC.cc)."""
+    return hemm(side, alpha, A, B, beta, C, opts=opts, uplo=uplo)
 
 
 def syrk(alpha, A, beta, C, opts=None, uplo=None):
@@ -143,13 +176,81 @@ def trmm(side, alpha, A, B, opts=None, uplo=None, diag=None):
     return write_back(B, out)
 
 
+def select_algo_trsm(A, B, opts: Options) -> MethodTrsm:
+    """Pick a trsm variant (src/trsm.cc:11-23 select_algo).
+
+    The reference picks stationary-A when B has a single block column (a
+    narrow right-hand side: moving nb×nrhs X blocks is cheaper than moving
+    A's panels), else stationary-B.  On one device both lower to the same
+    XLA TriangularSolve; on a grid they are genuinely different dataflows
+    (parallel/solvers.py trsmA_distributed vs trsm_distributed)."""
+    if opts.method_trsm != MethodTrsm.Auto:
+        return opts.method_trsm
+    B_nt = B.nt if isinstance(B, BaseMatrix) else 2
+    return MethodTrsm.A if B_nt < 2 else MethodTrsm.B
+
+
+def _trsm_dispatch(method, side, alpha, A, B, opts, uplo, diag):
+    from .core.matrix import distribution_grid
+
+    grid = distribution_grid(A, B)
+    if grid is None:
+        # one device: stationarity is a communication concept; both methods
+        # are the same blocked TriangularSolve
+        out = blas3.trsm(side, _uplo_of(A, uplo), _diag_of(A, diag),
+                         alpha, as_array(A), as_array(B))
+        return write_back(B, out)
+    from .parallel.solvers import trsmA_distributed, trsm_distributed
+
+    u = _uplo_of(A, uplo)
+    d = _diag_of(A, diag)
+    a, b = as_array(A), as_array(B)
+    s = Side.from_string(side)
+    if s == Side.Right:
+        # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T: reuse the left
+        # sweeps on transposed operands (work_trsmA.cc:79-89 does the same)
+        a, b = a.T, jnp.swapaxes(b, -1, -2)
+        u = Uplo.Upper if u == Uplo.Lower else Uplo.Lower
+    lower = u == Uplo.Lower
+    if method == MethodTrsm.A:
+        out = trsmA_distributed(a, jnp.asarray(alpha, b.dtype) * b, grid,
+                                lower=lower, unit_diag=(d == Diag.Unit))
+    else:
+        if d == Diag.Unit:
+            # stationary-B's fused TriangularSolve has no unit flag here:
+            # make the implicit unit diagonal explicit instead
+            idx = jnp.arange(a.shape[-1])
+            a = a.at[idx, idx].set(1.0)
+        out = trsm_distributed(a, jnp.asarray(alpha, b.dtype) * b, grid,
+                               lower=lower)
+    if s == Side.Right:
+        out = jnp.swapaxes(out, -1, -2)
+    return write_back(B, out)
+
+
 def trsm(side, alpha, A, B, opts=None, uplo=None, diag=None):
     """Solve op(T) X = alpha B in place of B (src/trsm.cc; work::trsm,
     work_trsm.cc:54-387 — the lookahead task DAG collapses into XLA's blocked
-    TriangularSolve on TPU)."""
-    out = blas3.trsm(side, _uplo_of(A, uplo), _diag_of(A, diag),
-                     alpha, as_array(A), as_array(B))
-    return write_back(B, out)
+    TriangularSolve on TPU).  Grid-bound operands dispatch between the
+    stationary-A and stationary-B distributed dataflows via select_algo."""
+    opts = Options.make(opts)
+    return _trsm_dispatch(select_algo_trsm(A, B, opts), side, alpha, A, B,
+                          opts, uplo, diag)
+
+
+def trsmA(side, alpha, A, B, opts=None, uplo=None, diag=None):
+    """Stationary-A triangular solve (src/trsmA.cc): A's tiles stay put, the
+    narrow B moves.  Explicit-method entry; trsm's select_algo picks this
+    form automatically when B has one block column."""
+    opts = Options.make(opts)
+    return _trsm_dispatch(MethodTrsm.A, side, alpha, A, B, opts, uplo, diag)
+
+
+def trsmB(side, alpha, A, B, opts=None, uplo=None, diag=None):
+    """Stationary-B triangular solve (src/trsmB.cc): B's tiles stay put, A's
+    panels are broadcast — the default for wide right-hand sides."""
+    opts = Options.make(opts)
+    return _trsm_dispatch(MethodTrsm.B, side, alpha, A, B, opts, uplo, diag)
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,6 @@ from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest
 from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
-                   tbsm)
+                   tbsm, tbsm_pivots, tbsmPivots)
 from .indefinite import (HermitianFactors, hesv, hetrf, hetrs, sysv, sytrf,
                          sytrs)

@@ -259,8 +259,10 @@ def tbsm(side, alpha, A, B, opts=None, uplo=None, diag=None, trans=False,
         a = as_array(A)
         u = Uplo.from_string(uplo)
         d = Diag.from_string(diag or "nonunit")
-        slate_assert(kd is not None, "tbsm on a raw array needs kd=")
-        kd_v = int(kd)
+        slate_assert(kd is not None or isinstance(pivots, BandLU),
+                     "tbsm on a raw array needs kd= (or BandLU pivots, "
+                     "which carry their own bandwidth)")
+        kd_v = int(kd) if kd is not None else 0   # BandLU overrides below
     b = as_array(B)
     squeeze = b.ndim == 1
     if squeeze:
@@ -285,6 +287,16 @@ def tbsm(side, alpha, A, B, opts=None, uplo=None, diag=None, trans=False,
     if squeeze:
         x = x[:, 0]
     return write_back(B, x)
+
+
+def tbsm_pivots(side, alpha, A, pivots, B, opts=None, **kw):
+    """Band triangular solve that applies LU row pivots ahead of each block
+    step (src/tbsmPivots.cc; the Pivots overload of slate.hh:302-311's tbsm).
+    Standalone driver for the forward sweep gbtrs composes internally."""
+    return tbsm(side, alpha, A, B, opts=opts, pivots=pivots, **kw)
+
+
+tbsmPivots = tbsm_pivots    # the reference's own camelCase spelling
 
 
 # ---------------------------------------------------------------------------

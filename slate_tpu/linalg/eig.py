@@ -662,7 +662,12 @@ def steqr(d, e, Z: Optional[jax.Array] = None, opts=None):
     rows, ``parallel.steqr_distributed``).  MethodEig.QR therefore means QR
     iteration semantics everywhere; the performance default for large
     vectors problems remains stedc (MethodEig.Auto/DC), the same split the
-    reference makes."""
+    reference makes.
+
+    ``opts`` is accepted for driver-signature parity (src/steqr.cc takes
+    Options) but the QR iteration has no tunables — it is intentionally
+    unused."""
+    del opts
     from .steqr_qr import steqr_qr
 
     return steqr_qr(d, e, Z)

@@ -19,9 +19,10 @@ from .summa import gemm_distributed, gemm_allgather, gemm_ring, summa_gemm
 from .blas3_dist import (herk_distributed, syrk_distributed, her2k_distributed,
                          syr2k_distributed, hemm_distributed, symm_distributed,
                          trmm_distributed, gbmm_distributed, hbmm_distributed)
-from .solvers import (potrf_distributed, trsm_distributed, posv_distributed,
-                      posv_mixed_distributed, posv_mixed_gmres_distributed,
-                      cholqr_distributed, gels_cholqr_distributed)
+from .solvers import (potrf_distributed, trsm_distributed, trsmA_distributed,
+                      posv_distributed, posv_mixed_distributed,
+                      posv_mixed_gmres_distributed, cholqr_distributed,
+                      gels_cholqr_distributed)
 from .lu_dist import (getrf_distributed, getrf_tall_distributed,
                       getrs_distributed, gesv_distributed,
                       gesv_mixed_distributed, gesv_mixed_gmres_distributed)

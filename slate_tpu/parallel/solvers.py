@@ -210,6 +210,94 @@ def posv_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
     return trsm_distributed(L, Y, grid, lower=True, conj_trans=True)
 
 
+_FLAT = (ROW_AXIS, COL_AXIS)      # flattened device axis for 1-D row layouts
+
+
+@lru_cache(maxsize=32)
+def _trsmA_dist_fn(mesh, npad: int, nb: int, nrhs: int, lower: bool,
+                   conj_trans: bool, unit_diag: bool, dtype_str: str):
+    """Stationary-A triangular solve (src/trsmA.cc + work/work_trsmA.cc:1-580).
+
+    The reference's trsmA keeps A's tiles where they live and moves the
+    (narrow) B around instead — the right trade when B has a single block
+    column (select_algo, src/trsm.cc:12-23).  Here: A is row-block-sharded
+    on the flattened mesh and NEVER communicated; the per-step traffic is
+    exactly one psum of the just-solved nb×nrhs X block (plus one more for
+    the column-panel reduction in the conj-transpose sweep) — O(n·nrhs)
+    total collective volume versus the O(n²)-class panel gathers of the
+    stationary-B form.
+
+    Sweep table (side=left; right is handled by the caller via transpose):
+      lower/notrans  -> forward,  row-panel product (owner-local)
+      lower/conjT    -> backward, column-panel psum reduction
+      upper/notrans  -> backward, row-panel product (owner-local)
+      upper/conjT    -> forward,  column-panel psum reduction
+    """
+    nproc = mesh.size
+    rl = npad // nproc                       # local rows per device
+    nt = npad // nb
+    forward = (lower and not conj_trans) or (not lower and conj_trans)
+
+    def local_fn(a_loc, b):                  # a_loc (rl, npad), b replicated
+        me = lax.axis_index(_FLAT)
+
+        def body(i, X):
+            k = i if forward else nt - 1 - i
+            k0 = k * nb
+            owner = k0 // rl
+            loc = k0 - owner * rl
+            akk = lax.dynamic_slice(a_loc, (loc, k0), (nb, nb))
+            bk = lax.dynamic_slice(b, (k0, 0), (nb, nrhs))
+            if not conj_trans:
+                # row-panel product: the owner holds block row k of A in
+                # full, X carries zeros on unsolved rows — no communication
+                # X is zero on every unsolved row (including block k), so the
+                # full row-panel product is exactly the solved-part update
+                row = lax.dynamic_slice(a_loc, (loc, 0), (nb, npad))
+                upd = jnp.matmul(row, X, precision=lax.Precision.HIGHEST)
+            else:
+                # column-panel reduction: block column k of A^H is spread
+                # over every device's rows — local partial + one psum
+                colp = lax.dynamic_slice(a_loc, (0, k0), (rl, nb))
+                Xl = lax.dynamic_slice(X, (me * rl, jnp.zeros((), me.dtype)),
+                                       (rl, nrhs))
+                part = jnp.matmul(jnp.conj(colp).T, Xl,
+                                  precision=lax.Precision.HIGHEST)
+                upd = lax.psum(part, _FLAT)
+            xk = lax.linalg.triangular_solve(
+                akk, bk - upd, left_side=True, lower=lower,
+                transpose_a=conj_trans, conjugate_a=conj_trans,
+                unit_diagonal=unit_diag)
+            xk = jnp.where(me == owner, xk, jnp.zeros_like(xk))
+            xk = lax.psum(xk, _FLAT)         # broadcast from the owner
+            return lax.dynamic_update_slice(X, xk, (k0, 0))
+
+        X = lax.fori_loop(0, nt, body, jnp.zeros_like(b))
+        return X
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(_FLAT, None), P(None, None)),
+                       out_specs=P(None, None), check_vma=False)
+    return jax.jit(fn)
+
+
+def trsmA_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
+                      lower: bool = True, conj_trans: bool = False,
+                      unit_diag: bool = False) -> jax.Array:
+    """Distributed left triangular solve, stationary-A dataflow
+    (src/trsmA.cc).  A stays row-sharded on the mesh; only nb×nrhs X blocks
+    travel.  Pads to a (nproc·nb)-aligned size with an identity tail."""
+    n, nrhs = B.shape[-2:]
+    nproc = grid.p * grid.q
+    nb = max(32, min(256, -(-n // nproc)))
+    Ap, _ = _pad_spd(A, nproc * nb)
+    npad = Ap.shape[-1]
+    Bp = jnp.pad(B, ((0, npad - n), (0, 0))) if npad != n else B
+    X = _trsmA_dist_fn(grid.mesh, npad, nb, int(Bp.shape[-1]), bool(lower),
+                       bool(conj_trans), bool(unit_diag), str(Ap.dtype))(Ap, Bp)
+    return X[:n]
+
+
 def _lower_dtype(dt):
     """The precision-ladder policy, shared with the single-device drivers
     (one source of truth: linalg.chol._lower_precision)."""

@@ -35,7 +35,10 @@ NOT_ROUTINES = {"scalar_t"}         # artifacts of the header scrape
 
 def reference_routines():
     names = set()
-    pat = re.compile(r"^[A-Za-z0-9_:<>,& ]*?\b([a-z][a-z0-9_]*)\s*\(")
+    # [A-Za-z0-9_] in the capture: camelCase drivers (trsmA, gemmC, hemmA,
+    # colNorms) are real public routines — the round-4 pattern silently
+    # dropped them from the audit
+    pat = re.compile(r"^[A-Za-z0-9_:<>,& ]*?\b([a-z][A-Za-z0-9_]*)\s*\(")
     with open(REF_HEADER) as f:
         for line in f:
             m = pat.match(line)
