@@ -28,6 +28,37 @@ def main():
     # block-size helpers
     mb = func.uniform_blocksize(7, 3)
     assert [mb(i) for i in range(3)] == [3, 3, 1]
+
+    # ---- genuinely non-uniform per-index tile grids (round 5) ----------
+    # MatrixStorage.hh:339-342 / func.hh:39-42: tileMb/tileNb as first-class
+    # lambdas (or explicit size vectors), honored by tiles, views, owner
+    # maps, and redistribute.
+    b = np.arange(10 * 12, dtype=np.float32).reshape(10, 12)
+    N = slate.Matrix.from_array(b, tile_mb=[2, 3, 1, 4], tile_nb=[5, 4, 3])
+    assert (N.mt, N.nt) == (4, 3)
+    assert [N.tileMb(i) for i in range(4)] == [2, 3, 1, 4]
+    np.testing.assert_array_equal(np.asarray(N.tile(1, 1)), b[2:5, 5:9])
+    # views keep the non-uniform grid: sub over tiles, transpose flips it
+    S = N.sub(1, 2, 0, 1)
+    assert [S.tileMb(i) for i in range(S.mt)] == [3, 1]
+    np.testing.assert_array_equal(np.asarray(N.T.tile(1, 1)), b[2:5, 5:9].T)
+    # custom rank map over the non-uniform grid
+    N2 = slate.Matrix.from_array(b, tile_mb=[2, 3, 1, 4], tile_nb=[5, 4, 3],
+                                 p=2, q=2, tile_rank=lambda i, j: (i + j) % 4)
+    assert N2.owner_map()[2, 1] == 3
+
+    # redistribute round-trip between two differently-distributed
+    # non-uniform wrappers (src/redistribute.cc)
+    from slate_tpu.parallel import redistribute_matrix
+    dst = slate.Matrix.from_array(np.zeros_like(b),
+                                  tile_mb=[2, 3, 1, 4], tile_nb=[5, 4, 3],
+                                  p=2, q=2, tile_rank=lambda i, j: (i * 3 + j) % 4)
+    redistribute_matrix(N2, dst)
+    np.testing.assert_array_equal(np.asarray(dst.array), b)
+    back = slate.Matrix.from_array(np.zeros_like(b),
+                                   tile_mb=[2, 3, 1, 4], tile_nb=[5, 4, 3])
+    redistribute_matrix(dst, back)
+    np.testing.assert_array_equal(np.asarray(back.array), b)
     print("ex13 OK")
 
 

@@ -40,6 +40,52 @@ from .exceptions import SlateError, slate_assert
 from .types import Diag, GridOrder, Op, TileKind, Uplo
 
 
+def _expand_tile_sizes(total: int, spec):
+    """Materialize a tile-size lambda / vector into an exact-cover tuple."""
+    if spec is None:
+        return None
+    if callable(spec):
+        sizes, s, i = [], 0, 0
+        while s < total:
+            b = int(spec(i))
+            slate_assert(b > 0, f"tile size lambda returned {b} at index {i}")
+            sizes.append(min(b, total - s))   # ragged last tile, like nb
+            s += b
+            i += 1
+        spec = sizes
+    sizes = [int(b) for b in spec]
+    slate_assert(all(b > 0 for b in sizes) and sum(sizes) == total,
+                 f"tile sizes {sizes} do not exactly cover dimension {total}")
+    return tuple(sizes)
+
+
+def _prefix(sizes):
+    if sizes is None:
+        return None
+    offs = [0]
+    for b in sizes:
+        offs.append(offs[-1] + b)
+    return tuple(offs)
+
+
+def _offset_index(offs, offset: int, what: str) -> int:
+    """Tile index whose boundary is exactly ``offset`` (views of non-uniform
+    matrices must stay tile-aligned — same restriction the reference's
+    sub/slice tile arithmetic has)."""
+    k = _offset_index_or_none(offs, offset)
+    slate_assert(k is not None,
+                 f"{what}: offset {offset} is not a tile boundary of the "
+                 f"non-uniform grid {offs}")
+    return k
+
+
+def _offset_index_or_none(offs, offset: int):
+    import bisect
+
+    k = bisect.bisect_left(offs, offset)
+    return k if k < len(offs) and offs[k] == offset else None
+
+
 class MatrixStorage:
     """Shared storage for a family of views (reference MatrixStorage.hh:150-1156).
 
@@ -50,15 +96,27 @@ class MatrixStorage:
     """
 
     __slots__ = ("array", "mb", "nb", "tile_rank", "grid", "kind", "p", "q",
-                 "order", "default_rank_map", "pool", "__weakref__")
+                 "order", "default_rank_map", "pool", "mb_sizes", "nb_sizes",
+                 "mb_offs", "nb_offs", "__weakref__")
 
     def __init__(self, array: jax.Array, mb: int, nb: int,
                  p: int = 1, q: int = 1, order: GridOrder = GridOrder.Col,
                  grid: Any = None, kind: TileKind = TileKind.SlateOwned,
-                 tile_rank: Optional[grid_funcs.TileRankFunc] = None):
+                 tile_rank: Optional[grid_funcs.TileRankFunc] = None,
+                 tile_mb=None, tile_nb=None):
         self.array = array
-        self.mb = int(mb)
-        self.nb = int(nb)
+        # first-class per-index tile-size lambdas (MatrixStorage.hh:339-342,
+        # func.hh:39-42): ``tile_mb``/``tile_nb`` may be a callable i -> size
+        # or an explicit size vector.  They live purely in the METADATA layer
+        # — tile accessors, views, owner maps and redistribution honor them,
+        # while compiled drivers keep their uniform pad-to-nb blocking
+        # (SURVEY §7 hard-part 5's pad-to-uniform boundary).
+        self.mb_sizes = _expand_tile_sizes(array.shape[-2], tile_mb)
+        self.nb_sizes = _expand_tile_sizes(array.shape[-1], tile_nb)
+        self.mb_offs = _prefix(self.mb_sizes)
+        self.nb_offs = _prefix(self.nb_sizes)
+        self.mb = int(mb) if self.mb_sizes is None else max(self.mb_sizes)
+        self.nb = int(nb) if self.nb_sizes is None else max(self.nb_sizes)
         self.p = int(p)
         self.q = int(q)
         self.order = GridOrder.from_string(order)
@@ -71,11 +129,17 @@ class MatrixStorage:
         # A real (>1 device) grid places the backing array at construction —
         # the reference ties the distribution into every matrix the same way
         # (MatrixStorage.hh:494-511 installs tileRank/tileDevice in the ctor).
-        if (grid is not None and getattr(grid, "size", 1) > 1
-                and hasattr(grid, "spec") and getattr(array, "ndim", 0) == 2):
-            self.array = jax.device_put(array, grid.spec())
+        self.place_on_grid()
         if _pool_tracking:
             _register_storage(self)
+
+    def place_on_grid(self) -> None:
+        """(Re)place the backing array onto the bound grid's block layout —
+        the single definition of "does this storage live on a device grid"."""
+        if (self.grid is not None and getattr(self.grid, "size", 1) > 1
+                and hasattr(self.grid, "spec")
+                and getattr(self.array, "ndim", 0) == 2):
+            self.array = jax.device_put(self.array, self.grid.spec())
 
     @property
     def m(self) -> int:
@@ -131,20 +195,70 @@ class BaseMatrix:
     def nb(self) -> int:
         return self.storage.mb if self.op != Op.NoTrans else self.storage.nb
 
+    def _row_tiles(self):
+        """(base, count, sizes, offs) of the view's LOGICAL-row tiling in
+        storage terms; sizes is None on the uniform path."""
+        st = self.storage
+        if self.op == Op.NoTrans:
+            sizes, offs, off0, ext, ub = (st.mb_sizes, st.mb_offs,
+                                          self.ioffset, self._m, st.mb)
+        else:
+            sizes, offs, off0, ext, ub = (st.nb_sizes, st.nb_offs,
+                                          self.joffset, self._n, st.nb)
+        return self._tiles_meta(sizes, offs, off0, ext, ub)
+
+    def _col_tiles(self):
+        st = self.storage
+        if self.op == Op.NoTrans:
+            sizes, offs, off0, ext, ub = (st.nb_sizes, st.nb_offs,
+                                          self.joffset, self._n, st.nb)
+        else:
+            sizes, offs, off0, ext, ub = (st.mb_sizes, st.mb_offs,
+                                          self.ioffset, self._m, st.mb)
+        return self._tiles_meta(sizes, offs, off0, ext, ub)
+
+    @staticmethod
+    def _tiles_meta(sizes, offs, off0, ext, ub):
+        if sizes is not None:
+            b0 = _offset_index_or_none(offs, off0)
+            b1 = _offset_index_or_none(offs, off0 + ext)
+            if b0 is not None and b1 is not None:
+                return b0, b1 - b0, sizes, offs
+            # non-tile-aligned slice of a non-uniform matrix: tile metadata
+            # re-bases to the max-block uniform fallback — the same semantics
+            # a misaligned slice already has on uniform matrices (tileRank
+            # keeps its own hard alignment check)
+        return None, grid_funcs.num_tiles(ext, ub), None, None
+
     @property
     def mt(self) -> int:
         """Row tile count (BaseMatrix.hh mt())."""
-        return grid_funcs.num_tiles(self.m, self.mb)
+        return self._row_tiles()[1]
 
     @property
     def nt(self) -> int:
-        return grid_funcs.num_tiles(self.n, self.nb)
+        return self._col_tiles()[1]
 
     def tileMb(self, i: int) -> int:
-        return grid_funcs.uniform_blocksize(self.m, self.mb)(i)
+        b0, _, sizes, _ = self._row_tiles()
+        if sizes is None:
+            return grid_funcs.uniform_blocksize(self.m, self.mb)(i)
+        return sizes[b0 + i]
 
     def tileNb(self, j: int) -> int:
-        return grid_funcs.uniform_blocksize(self.n, self.nb)(j)
+        b0, _, sizes, _ = self._col_tiles()
+        if sizes is None:
+            return grid_funcs.uniform_blocksize(self.n, self.nb)(j)
+        return sizes[b0 + j]
+
+    def _logical_tile_offset(self, axis: int, t: int) -> int:
+        """View-relative element offset of logical tile ``t`` along
+        ``axis`` (0 = rows, 1 = cols)."""
+        b0, _, sizes, offs = self._row_tiles() if axis == 0 else \
+            self._col_tiles()
+        if sizes is None:
+            return t * (self.mb if axis == 0 else self.nb)
+        return offs[b0 + t] - offs[b0]
 
     def tileRank(self, i: int, j: int) -> int:
         """Tile owner rank in the flattened p×q grid (MatrixStorage.hh:339).
@@ -152,13 +266,22 @@ class BaseMatrix:
         Only meaningful on tile-aligned views (anything built via ctor/sub/transpose);
         a ``slice`` at a non-tile-aligned offset has no well-defined tile->rank map.
         """
-        slate_assert(self.ioffset % self.storage.mb == 0
-                     and self.joffset % self.storage.nb == 0,
-                     "tileRank on a non-tile-aligned slice view")
+        st = self.storage
         if self.op != Op.NoTrans:
             i, j = j, i
-        return self.storage.tile_rank(self.ioffset // self.storage.mb + i,
-                                      self.joffset // self.storage.nb + j)
+        if st.mb_sizes is None:
+            slate_assert(self.ioffset % st.mb == 0,
+                         "tileRank on a non-tile-aligned slice view")
+            si = self.ioffset // st.mb + i
+        else:
+            si = _offset_index(st.mb_offs, self.ioffset, "tileRank") + i
+        if st.nb_sizes is None:
+            slate_assert(self.joffset % st.nb == 0,
+                         "tileRank on a non-tile-aligned slice view")
+            sj = self.joffset // st.nb + j
+        else:
+            sj = _offset_index(st.nb_offs, self.joffset, "tileRank") + j
+        return st.tile_rank(si, sj)
 
     def tileIsLocal(self, i: int, j: int) -> bool:
         """Whether tile (i, j) is owned by this process's rank on the grid
@@ -189,7 +312,9 @@ class BaseMatrix:
         import numpy as np
         from .. import native
         if (self.op == Op.NoTrans and self.ioffset == 0 and self.joffset == 0
-                and self.storage.default_rank_map):
+                and self.storage.default_rank_map
+                and self.storage.mb_sizes is None
+                and self.storage.nb_sizes is None):
             order, p, q = self.gridinfo()
             return native.owner_map(self.mt, self.nt, p, q, order)
         return np.array([[self.tileRank(i, j) for j in range(self.nt)]
@@ -201,7 +326,9 @@ class BaseMatrix:
         import numpy as np
         from .. import native
         if (self.op == Op.NoTrans and self.ioffset == 0 and self.joffset == 0
-                and self.storage.default_rank_map):
+                and self.storage.default_rank_map
+                and self.storage.mb_sizes is None
+                and self.storage.nb_sizes is None):
             order, p, q = self.gridinfo()
             return native.local_tiles(self.mt, self.nt, p, q, rank, order)
         ii, jj = np.nonzero(self.owner_map() == rank)
@@ -238,7 +365,7 @@ class BaseMatrix:
     def _tile_storage_coords(self, i: int, j: int):
         """Map logical tile (i, j) to a storage-coordinate slice (op un-applied)."""
         mb_log, nb_log = self.tileMb(i), self.tileNb(j)
-        io, jo = i * self.mb, j * self.nb
+        io, jo = self._logical_tile_offset(0, i), self._logical_tile_offset(1, j)
         if self.op != Op.NoTrans:
             io, jo = jo, io
             mb_log, nb_log = nb_log, mb_log
@@ -287,7 +414,7 @@ class BaseMatrix:
                      f"sub({i1},{i2},{j1},{j2}) out of range {self.mt}x{self.nt}")
         m = sum(self.tileMb(i) for i in range(i1, i2 + 1))
         n = sum(self.tileNb(j) for j in range(j1, j2 + 1))
-        io, jo = i1 * self.mb, j1 * self.nb
+        io, jo = self._logical_tile_offset(0, i1), self._logical_tile_offset(1, j1)
         if self.op != Op.NoTrans:
             io, jo, m, n = jo, io, n, m
         return self._make_view(self.ioffset + io, self.joffset + jo, m, n, self.op)
@@ -383,20 +510,37 @@ class Matrix(BaseMatrix):
     @classmethod
     def from_array(cls, a, nb: int = 256, p: int = 1, q: int = 1,
                    mb: Optional[int] = None, order: GridOrder = GridOrder.Col,
-                   grid: Any = None) -> "Matrix":
+                   grid: Any = None, tile_rank=None,
+                   tile_mb=None, tile_nb=None) -> "Matrix":
         """Wrap existing data (reference fromLAPACK, Matrix.hh:293; the array is adopted
-        as UserOwned origin data)."""
+        as UserOwned origin data).  ``tile_mb``/``tile_nb`` (callable i -> size
+        or size vector) install non-uniform per-index tile grids
+        (MatrixStorage.hh:339-342, func.hh:39-42); ``tile_rank`` a custom
+        tile -> rank lambda."""
         a = jnp.asarray(a)
         slate_assert(a.ndim == 2, "from_array expects a 2-D array")
         storage = MatrixStorage(a, mb or nb, nb, p, q, order, grid,
-                                kind=TileKind.UserOwned)
+                                kind=TileKind.UserOwned, tile_rank=tile_rank,
+                                tile_mb=tile_mb, tile_nb=tile_nb)
         return cls(0, 0, nb, _storage=storage)
 
     def empty_like(self, m: Optional[int] = None, n: Optional[int] = None,
                    nb: Optional[int] = None, dtype=None) -> "Matrix":
-        """New zeroed matrix with this one's distribution (Matrix.hh emptyLike:117)."""
+        """New zeroed matrix with this one's distribution (Matrix.hh emptyLike:117).
+        A source non-uniform tile grid is carried over when the shape and
+        blocking are unchanged."""
         s = self.storage
-        return Matrix(self.m if m is None else m, self.n if n is None else n,
+        mm = self.m if m is None else m
+        nn = self.n if n is None else n
+        if (nb is None and (s.mb_sizes is not None or s.nb_sizes is not None)
+                and mm == s.m and nn == s.n and self.op == Op.NoTrans):
+            arr = jnp.zeros((mm, nn), dtype=dtype or self.dtype)
+            storage = MatrixStorage(arr, s.mb, s.nb, s.p, s.q, s.order, s.grid,
+                                    tile_rank=(None if s.default_rank_map
+                                               else s.tile_rank),
+                                    tile_mb=s.mb_sizes, tile_nb=s.nb_sizes)
+            return Matrix(0, 0, s.nb, _storage=storage)
+        return Matrix(mm, nn,
                       nb or self.nb, s.p, s.q, order=s.order, grid=s.grid,
                       dtype=dtype or self.dtype)
 

@@ -14,7 +14,8 @@ from .mesh import ProcessGrid
 from .collectives import (axis_bcast, axis_allreduce, axis_reduce_scatter, ring_shift,
                           axis_index)
 from .distribute import (block_spec, distribute, replicate, redistribute,
-                         cyclic_to_blocked, blocked_to_cyclic, cyclic_permutation)
+                         redistribute_matrix, cyclic_to_blocked,
+                         blocked_to_cyclic, cyclic_permutation)
 from .summa import gemm_distributed, gemm_allgather, gemm_ring, summa_gemm
 from .blas3_dist import (herk_distributed, syrk_distributed, her2k_distributed,
                          syr2k_distributed, hemm_distributed, symm_distributed,

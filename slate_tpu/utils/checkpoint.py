@@ -36,6 +36,11 @@ def save_matrix(path: str, A, **extra) -> None:
         order, p, q = A.gridinfo()
         meta.update(type=type(A).__name__, mb=A.storage.mb, nb=A.storage.nb,
                     p=p, q=q, order=str(order))
+        # non-uniform per-index tile grids survive the round trip
+        if A.storage.mb_sizes is not None:
+            meta["tile_mb"] = np.asarray(A.storage.mb_sizes, dtype=np.int64)
+        if A.storage.nb_sizes is not None:
+            meta["tile_nb"] = np.asarray(A.storage.nb_sizes, dtype=np.int64)
         for attr in ("uplo", "diag"):
             if hasattr(A, attr):
                 meta[attr] = str(getattr(A, attr))
@@ -69,6 +74,10 @@ def load_matrix(path: str, p: Optional[int] = None, q: Optional[int] = None):
     if tname == "Matrix":
         # Matrix supports rectangular tiles + grid order; restore them exactly
         from ..core.types import GridOrder
+        if "tile_mb" in meta:
+            kw["tile_mb"] = [int(b) for b in np.atleast_1d(meta["tile_mb"])]
+        if "tile_nb" in meta:
+            kw["tile_nb"] = [int(b) for b in np.atleast_1d(meta["tile_nb"])]
         return Matrix.from_array(data, mb=int(meta.get("mb", nb)),
                                  order=GridOrder.from_string(str(meta["order"])),
                                  **kw)

@@ -78,8 +78,16 @@ def print_matrix(label: str, A, verbose: int = 3, width: int = 10,
         else:
             tile_rows = tile_cols = None
             if verbose >= 4 and isinstance(A, BaseMatrix):
-                tile_rows = {min((i + 1) * A.mb, m) for i in range(A.mt)}
-                tile_cols = {min((j + 1) * A.nb, n) for j in range(A.nt)}
+                # cumulative tileMb/tileNb — correct for non-uniform grids
+                # (scalar mb/nb are max block sizes there, not boundaries)
+                acc_r, acc_c = 0, 0
+                tile_rows, tile_cols = set(), set()
+                for i in range(A.mt):
+                    acc_r += A.tileMb(i)
+                    tile_rows.add(min(acc_r, m))
+                for j in range(A.nt):
+                    acc_c += A.tileNb(j)
+                    tile_cols.add(min(acc_c, n))
             out.append(f"{label} = [")
             out.extend(_rows(a, width, precision, tile_rows, tile_cols))
             out.append("]")
