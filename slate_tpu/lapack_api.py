@@ -185,6 +185,29 @@ def _gecon(dt, norm, lu_, ipiv, anorm):
                                _opts(), norm_kind=kind))
 
 
+def _laset(dt, uplo, m, n, alpha, beta, a=None):
+    """dlaset (scalapack_api/scalapack_laset.cc): set the selected region of
+    A to alpha off-diagonal / beta on the diagonal.  ``uplo`` 'g' sets the
+    whole matrix, 'l'/'u' the triangle (the untouched triangle keeps A's
+    entries, which is why A is an optional input)."""
+    from .ops import elementwise
+
+    u = str(uplo).lower()[0]
+    m, n = int(m), int(n)
+    if a is None:
+        a = np.zeros((m, n), dtype=dt)
+    (a,) = _as(dt, a)
+    aj = jnp.asarray(a)
+    # LAPACK sets only the leading m x n region of A; the rest is untouched
+    sub = aj[:m, :n]
+    if u in ("l", "u"):
+        out = elementwise.tzset(Uplo.Lower if u == "l" else Uplo.Upper,
+                                alpha, beta, sub)
+    else:
+        out = elementwise.geset(alpha, beta, sub)
+    return np.asarray(aj.at[:m, :n].set(out))
+
+
 def _posv(dt, uplo, a, b):
     a, b = _as(dt, a, b)
     M = HermitianMatrix.from_array(Uplo.from_string(uplo), a.copy(),
@@ -342,7 +365,7 @@ _FAMILIES = {
     "her2k": (_her2k, {}), "syr2k": (_her2k, {"sy": True}),
     "trmm": (_trmm, {}), "trsm": (_trmm, {"solve": True}),
     "lange": (_lange, {}), "lanhe": (_lanhe, {}), "lansy": (_lanhe, {"sy": True}),
-    "lantr": (_lantr, {}),
+    "lantr": (_lantr, {}), "laset": (_laset, {}),
     "gesv": (_gesv, {}), "gesv_mixed": (_gesv_mixed, {}),
     "getrf": (_getrf, {}), "getrs": (_getrs, {}), "getri": (_getri, {}),
     "gecon": (_gecon, {}),

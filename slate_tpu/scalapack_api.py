@@ -343,6 +343,57 @@ def _ptrmm_distributed(dt, side, uplo, transa, diag, alpha, a, b):
     return np.asarray(out)
 
 
+def _plantr_distributed(dt, norm, uplo, diag, a):
+    from .parallel import norm_distributed
+
+    import jax.numpy as jnp
+
+    aj = _jnp(np.asarray(a, dtype=dt))
+    if str(diag).lower().startswith("u"):
+        idx = jnp.arange(min(aj.shape[-2:]))
+        aj = aj.at[idx, idx].set(1.0)
+    u = "lower" if str(uplo).lower().startswith("l") else "upper"
+    return float(norm_distributed(_norm_kind(norm), aj, _grid, uplo=u))
+
+
+def _pgecon_distributed(dt, norm, lu_, ipiv, anorm):
+    from .core.types import Norm
+    from .parallel import gecondest_distributed
+
+    kind = Norm.Inf if str(norm).lower()[0] == "i" else Norm.One
+    perm = _jnp(_lapi._perm(ipiv))
+    return float(gecondest_distributed(_jnp(np.asarray(lu_, dtype=dt)), perm,
+                                       anorm, _grid, norm_kind=kind))
+
+
+def _ppocon_distributed(dt, uplo, lf, anorm):
+    from .parallel import pocondest_distributed
+
+    lf = np.asarray(lf, dtype=dt)
+    if str(uplo).lower().startswith("u"):
+        lf = lf.conj().T.copy()       # the mesh kernel consumes the L factor
+    return float(pocondest_distributed(_jnp(lf), anorm, _grid))
+
+
+def _pgetri_distributed(dt, lu_, ipiv):
+    from .parallel import getri_distributed
+
+    perm = _jnp(_lapi._perm(ipiv))
+    return np.asarray(getri_distributed(_jnp(np.asarray(lu_, dtype=dt)),
+                                        perm, _grid))
+
+
+def _ppotri_distributed(dt, uplo, lf):
+    from .parallel import potri_distributed
+
+    lf = np.asarray(lf, dtype=dt)
+    upper = str(uplo).lower().startswith("u")
+    if upper:
+        lf = lf.conj().T.copy()
+    out = np.asarray(potri_distributed(_jnp(np.tril(lf)), _grid, lower=True))
+    return out.conj().T.copy() if upper else out
+
+
 def _norm_kind(norm):
     """Resolve a LAPACK norm character through the shared Norm enum — unknown
     characters raise exactly like the single-device fallback path."""
@@ -378,6 +429,14 @@ _DISTRIBUTED = {
     "hemm": _phemm_distributed,
     "symm": _psymm_distributed,
     "trmm": _ptrmm_distributed,
+    # laset intentionally has no _DISTRIBUTED entry: the numpy-ABI skin
+    # gathers to host either way, so the elementwise fill runs through the
+    # shared single-device driver (a device round-trip would be pure cost)
+    "lantr": _plantr_distributed,
+    "gecon": _pgecon_distributed,
+    "pocon": _ppocon_distributed,
+    "getri": _pgetri_distributed,
+    "potri": _ppotri_distributed,
 }
 
 
