@@ -220,7 +220,7 @@ def _trsm_dispatch(method, side, alpha, A, B, opts, uplo, diag):
             # stationary-B's fused TriangularSolve has no unit flag here:
             # make the implicit unit diagonal explicit instead
             idx = jnp.arange(a.shape[-1])
-            a = a.at[idx, idx].set(1.0)
+            a = a.at[idx, idx].set(jnp.asarray(1.0, a.dtype))
         out = trsm_distributed(a, jnp.asarray(alpha, b.dtype) * b, grid,
                                lower=lower)
     if s == Side.Right:

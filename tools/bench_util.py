@@ -1,0 +1,29 @@
+"""Shared timing helpers for the tools/ benchmarking scripts.
+
+One definition of the device fence so every tool measures the same way
+(the round-4 lesson about timing protocols drifting between scripts).
+"""
+
+import time
+
+import jax
+
+
+def fence(x):
+    """Block on every array in a pytree; returns the pytree."""
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, x)
+    return x
+
+
+def best_of(fn, *args, repeats=3, **kw):
+    """Steady-state best-of-N wall time: one warm-up (compile) call, then
+    the minimum of ``repeats`` fenced timings.  Returns (seconds, result)."""
+    out = fence(fn(*args, **kw))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fence(fn(*args, **kw))
+        best = min(best, time.perf_counter() - t0)
+    return best, out

@@ -26,11 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def fence(x):
-    jax.tree_util.tree_map(
-        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
-        else a, x)
-    return x
+from bench_util import fence  # one fence definition across the tools
 
 
 def timed(label, fn, *args, **kw):
