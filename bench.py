@@ -377,6 +377,16 @@ def child_norm(cpu_fallback):
 
     import slate_tpu
 
+    # BENCH_NORM_IMPL=xla times the plain fused-XLA reduction instead of
+    # the Pallas streaming kernels — the on-chip A/B for the 0.26x round-3
+    # reading (if XLA's reduction already runs at bandwidth, the fix is a
+    # routing default, not a kernel)
+    tag = ""
+    if os.environ.get("BENCH_NORM_IMPL", "").lower() == "xla":
+        from slate_tpu.ops import norms as _norm_ops
+        _norm_ops.USE_PALLAS = False
+        tag = "_xla"
+
     def body(i, c, a):
         ap = a + c[0]                      # chain dependence: ~2 HBM passes
         f = slate_tpu.norm("fro", ap)      # 1 pass (Pallas streaming kernel)
@@ -393,7 +403,8 @@ def child_norm(cpu_fallback):
     # into the norm reads (then 3); the 1/4 attribution is the conservative
     # end, stated here so the number is interpretable.
     gflops, per_iter = _chain_rate(body, c0, (a,), ks, kl, 4.0 * 2.0 * n * n)
-    _emit({"metric": f"genorm_fro_f32_n{n}_gflops", "value": round(gflops, 1),
+    _emit({"metric": f"genorm_fro{tag}_f32_n{n}_gflops",
+           "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter,
            "note": "fro+one+perturb per iter (~4 passes); rate = fro model "
                    "over 1/4 iter time"})
@@ -641,6 +652,12 @@ CHILDREN = {
 
 def _run_child(name, cpu_fallback, timeout):
     env = dict(os.environ)
+    # variant A/B knobs are --child-only: a parent run must never record a
+    # variant-tagged measurement into BENCH_LKG.json under the default
+    # config key (it would be scored against the default baseline and
+    # backfilled as the kernel's last-known-good)
+    for knob in ("BENCH_NORM_IMPL", "BENCH_POTRF_INVTRSM"):
+        env.pop(knob, None)
     if cpu_fallback:
         # JAX_PLATFORMS=cpu alone is NOT enough: the ambient sitecustomize hook
         # registers the real-TPU 'axon' PJRT plugin and hangs on a wedged

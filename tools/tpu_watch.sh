@@ -34,6 +34,9 @@ for i in $(seq 1 400); do
     echo "[sweep] potrf inverse-apply panel"
     BENCH_POTRF_INVTRSM=1 timeout 1200 \
       python bench.py --child potrf 2>&1 | tail -1
+    echo "[sweep] norm via plain XLA reduction (A/B vs Pallas)"
+    BENCH_NORM_IMPL=xla timeout 1200 \
+      python bench.py --child norm 2>&1 | tail -1
     for nb in 1024 4096; do
       echo "[sweep] potrf_la nb=$nb"
       BENCH_POTRF_LA_NB=$nb timeout 1200 \
