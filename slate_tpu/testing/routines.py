@@ -149,6 +149,37 @@ def run_trsm(p, slate):
     return _result(p, _rel(np.linalg.norm(R), scale), flops, t)
 
 
+@_routine("trsmA", "blas3")
+def run_trsmA(p, slate):
+    """Stationary-A triangular solve (src/trsmA.cc): same identity check as
+    trsm through the explicit-method driver."""
+    m, n = p["m"], p["n"]
+    T = np.tril(_gen("rands", m, m, p)) + m * np.eye(m, dtype=p["dtype"])
+    B0 = _gen("rands", m, n, p)
+    Bm = slate.Matrix.from_array(B0.copy(), nb=p["nb"])
+    Tm = slate.TriangularMatrix.from_array(slate.Uplo.Lower, T, nb=p["nb"])
+    _, t = time_call(lambda: slate.trsmA("left", 1.0, Tm, Bm),
+                     repeat=p["repeat"])
+    X = np.asarray(Bm.array)
+    scale = np.linalg.norm(T) * np.linalg.norm(X)
+    return _result(p, _rel(np.linalg.norm(T @ X - B0), scale), m * m * n, t)
+
+
+@_routine("trsmB", "blas3")
+def run_trsmB(p, slate):
+    """Stationary-B triangular solve (src/trsmB.cc)."""
+    m, n = p["m"], p["n"]
+    T = np.tril(_gen("rands", m, m, p)) + m * np.eye(m, dtype=p["dtype"])
+    B0 = _gen("rands", m, n, p)
+    Bm = slate.Matrix.from_array(B0.copy(), nb=p["nb"])
+    Tm = slate.TriangularMatrix.from_array(slate.Uplo.Lower, T, nb=p["nb"])
+    _, t = time_call(lambda: slate.trsmB("left", 1.0, Tm, Bm),
+                     repeat=p["repeat"])
+    X = np.asarray(Bm.array)
+    scale = np.linalg.norm(T) * np.linalg.norm(X)
+    return _result(p, _rel(np.linalg.norm(T @ X - B0), scale), m * m * n, t)
+
+
 @_routine("trmm", "blas3")
 def run_trmm(p, slate):
     """op(T) B vs dense multiply."""
@@ -501,6 +532,25 @@ def run_heev(p, slate):
     err1 = _rel(np.linalg.norm(A @ Z - Z * lam[None, :]), np.linalg.norm(A))
     err2 = np.linalg.norm(Z.conj().T @ Z - np.eye(n)) / n
     return _result(p, max(err1, err2), 9.0 * n ** 3, t)
+
+
+@_routine("steqr", "eig")
+def run_steqr(p, slate):
+    """Tridiagonal QR iteration (src/steqr.cc): ‖T Q − Q Λ‖/‖T‖ +
+    orthogonality, real implicit-shift sweeps at every size."""
+    import numpy.random as _r
+    n = p["n"]
+    rng = np.random.default_rng(p["seed"])
+    d = rng.standard_normal(n).astype(p["dtype"])
+    e = rng.standard_normal(n - 1).astype(p["dtype"])
+    T = np.diag(d.astype(np.float64)) + np.diag(e.astype(np.float64), 1) \
+        + np.diag(e.astype(np.float64), -1)
+    (lam, Q), t = time_call(lambda: slate.steqr(d, e), repeat=p["repeat"])
+    lam, Q = np.asarray(lam, np.float64), np.asarray(Q, np.float64)
+    err1 = _rel(np.linalg.norm(T @ Q - Q * lam[None, :]), np.linalg.norm(T))
+    err2 = np.linalg.norm(Q.T @ Q - np.eye(n)) / n
+    # ~3 sweeps/eigenvalue x n^2-class rotation+gemm work: 6 n^3 job model
+    return _result(p, max(err1, err2), 6.0 * n ** 3, t)
 
 
 @_routine("hegv", "eig")
