@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.exceptions import SlateError
+from ..core.exceptions import SlateError, slate_assert
 from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array,
                            distribution_grid, write_back)
 from ..core.types import MethodEig, Norm, Options, Target, Uplo
@@ -537,6 +537,46 @@ def _hb2st_q(Vs: jax.Array, taus: jax.Array, n: int, b: int) -> jax.Array:
     return sweep_accumulate(Vs, taus, n, b)
 
 
+def _hb2st_run_chase(b_arr: jax.Array, kd: int, pipeline: bool):
+    """Normalize band storage to the full dense Hermitian form and run the
+    bulge chase; returns (d, e_c, Vs, taus) — the reflector-level output."""
+    n = b_arr.shape[-1]
+    idx = jnp.arange(n)
+    lower = jnp.tril(b_arr, -1)
+    upper = jnp.triu(b_arr, 1)
+    have_lower = jnp.any(jnp.abs(lower) > 0)
+    diag_part = jnp.zeros_like(b_arr).at[idx, idx].set(
+        jnp.diagonal(b_arr).real.astype(b_arr.dtype))
+    full_from_lower = diag_part + lower + jnp.conj(lower.T)
+    full_from_upper = diag_part + upper + jnp.conj(upper.T)
+    both = diag_part + lower + upper
+    symmetric_already = jnp.any(jnp.abs(lower) > 0) & jnp.any(jnp.abs(upper) > 0)
+    full = jnp.where(symmetric_already, both,
+                     jnp.where(have_lower, full_from_lower, full_from_upper))
+    chase = _hb2st_chase_pipelined if pipeline else _hb2st_chase
+    return chase(full, kd)
+
+
+def hb2st_reflectors(band, kd: Optional[int] = None, pipeline: bool = False):
+    """Stage-2 chase returning the REFLECTOR-level output (d, e_c, Vs, taus)
+    without materializing Q2.
+
+    The hook the distributed layer uses to shard the Q2 accumulation —
+    which dominates the vectors path (~97% profiled) — over mesh rows: the
+    scalar chase replays replicated, each device accumulates its own row
+    block via ``sweep_accumulate(..., Q0=rows)``, zero collectives (the
+    reference redistributes Z to 1-D rows for unmtr_hb2st the same way,
+    heev.cc:193-205).  Requires kd > 1 and n > 2 (the band cases with an
+    actual chase)."""
+    b_arr = as_array(band)
+    if kd is None:
+        kd = _infer_bandwidth(b_arr)
+    n = b_arr.shape[-1]
+    slate_assert(kd > 1 and n > 2,
+                 "hb2st_reflectors needs kd > 1 and n > 2 (no chase below)")
+    return _hb2st_run_chase(b_arr, kd, pipeline)
+
+
 def _infer_bandwidth(b) -> int:
     """Eagerly infer the bandwidth of a concrete band matrix (numpy; used when
     the caller does not pass kd — requires a concrete array, not a tracer)."""
@@ -580,20 +620,7 @@ def hb2st(band, kd: Optional[int] = None, opts=None, want_vectors: bool = False,
     n = b_arr.shape[-1]
     idx = jnp.arange(n)
     if kd > 1 and n > 2:
-        # normalize storage to the full dense Hermitian band
-        lower = jnp.tril(b_arr, -1)
-        upper = jnp.triu(b_arr, 1)
-        have_lower = jnp.any(jnp.abs(lower) > 0)
-        diag_part = jnp.zeros_like(b_arr).at[idx, idx].set(
-            jnp.diagonal(b_arr).real.astype(b_arr.dtype))
-        full_from_lower = diag_part + lower + jnp.conj(lower.T)
-        full_from_upper = diag_part + upper + jnp.conj(upper.T)
-        both = diag_part + lower + upper
-        symmetric_already = jnp.any(jnp.abs(lower) > 0) & jnp.any(jnp.abs(upper) > 0)
-        full = jnp.where(symmetric_already, both,
-                         jnp.where(have_lower, full_from_lower, full_from_upper))
-        chase = _hb2st_chase_pipelined if pipeline else _hb2st_chase
-        d, e_c, Vs, taus = chase(full, kd)
+        d, e_c, Vs, taus = _hb2st_run_chase(b_arr, kd, pipeline)
         e = jnp.abs(e_c)
         if not want_vectors:
             return d, e

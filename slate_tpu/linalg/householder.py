@@ -169,7 +169,7 @@ _SWEEP_GROUP = 8
 
 
 def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
-                     group: int = _SWEEP_GROUP) -> jax.Array:
+                     group: int = _SWEEP_GROUP, Q0=None) -> jax.Array:
     """Accumulate Q = prod_s prod_r H_{s,r} (chronological) from bulge-chase
     reflectors whose supports within sweep s are the adjacent length-b blocks
     starting at row/col ``s + 1 + r*b``.
@@ -183,7 +183,12 @@ def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
     back-to-back in registers between one slice and one write — the
     accumulation is bandwidth-bound (profiled at ~97% of the n=2,048
     vectors path), so the traffic drops ~group×.  Returns the dense
-    (n, n) Q.
+    (n, n) Q — or, with ``Q0`` (an (m, n) initial row block replacing the
+    identity), the (m, n) product ``Q0 · Q``.  Every update is a pure
+    column operation, so rows are embarrassingly parallel: ``Q0`` is the
+    hook the distributed layer uses to shard the accumulation over mesh
+    rows with zero collectives (the reference's unmtr_hb2st 1-D row
+    distribution, heev.cc:193-205).
     """
     n_sweeps, m_max, _ = Vs.shape
     dt = Vs.dtype
@@ -197,19 +202,21 @@ def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
         taus = jnp.concatenate([taus, jnp.zeros((pad_s, m_max), dt)], axis=0)
     win = m_max * b + group - 1
     ncols = n + win + b + group
-    Q = jnp.zeros((n, ncols), dt).at[:, :n].set(jnp.eye(n, dtype=dt))
+    m = n if Q0 is None else Q0.shape[-2]
+    Q = jnp.zeros((m, ncols), dt).at[:, :n].set(
+        jnp.eye(n, dtype=dt) if Q0 is None else Q0.astype(dt))
 
     def body(g, Q):
         s0 = g * group
-        W = lax.dynamic_slice(Q, (0, s0 + 1), (n, win))
+        W = lax.dynamic_slice(Q, (0, s0 + 1), (m, win))
         for gi in range(group):           # in-register: one HBM round trip
             V = lax.dynamic_index_in_dim(Vs, s0 + gi, 0, keepdims=False)
             t = lax.dynamic_index_in_dim(taus, s0 + gi, 0, keepdims=False)
             S = lax.slice_in_dim(W, gi, gi + m_max * b, axis=1)
-            S = S.reshape(n, m_max, b)
+            S = S.reshape(m, m_max, b)
             y = jnp.einsum("nrb,rb->nr", S, V)
             S = S - jnp.einsum("r,nr,rb->nrb", t, y, jnp.conj(V))
-            W = lax.dynamic_update_slice(W, S.reshape(n, m_max * b), (0, gi))
+            W = lax.dynamic_update_slice(W, S.reshape(m, m_max * b), (0, gi))
         return lax.dynamic_update_slice(Q, W, (0, s0 + 1))
 
     Q = lax.fori_loop(0, ng, body, Q)

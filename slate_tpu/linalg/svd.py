@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.exceptions import slate_assert
 from ..core.matrix import BaseMatrix, as_array
 from ..core.types import Options
 from ..utils.trace import Timers, trace_block
@@ -456,6 +457,22 @@ def _bidiag_phases(d_c, e_c, dt):
     return pu, pw
 
 
+def tb2bd_reflectors(band, kd, pipeline: bool = False):
+    """Stage-2 bidiagonal chase at the REFLECTOR level:
+    (d_c, e_c, Us, tauus, Vs, tauvs) without materializing U2/VT2.
+
+    Hook for the distributed layer's row-sharded vectors accumulation
+    (``parallel.eig_dist``): the two sweep_accumulate calls dominate the
+    vectors path and every update is a column operation, so each device
+    builds its own row block with zero collectives.  Requires kd > 1."""
+    b = as_array(band)
+    slate_assert(kd > 1, "tb2bd_reflectors needs kd > 1 (no chase below)")
+    kb = min(b.shape[-2:])
+    sq = b[..., :kb, :kb]
+    chase = _tb2bd_chase_pipelined if pipeline else _tb2bd_chase
+    return chase(sq, kd)
+
+
 def tb2bd(band, kd, opts=None, want_vectors: bool = False,
           pipeline: bool = False):
     """Stage 2: band -> bidiagonal bulge chasing (src/tb2bd.cc; kernels
@@ -472,9 +489,8 @@ def tb2bd(band, kd, opts=None, want_vectors: bool = False,
     b = as_array(band)
     if kd > 1:
         kb = min(b.shape[-2:])
-        sq = b[..., :kb, :kb]
-        chase = _tb2bd_chase_pipelined if pipeline else _tb2bd_chase
-        d_c, e_c, Us, tauus, Vs, tauvs = chase(sq, kd)
+        d_c, e_c, Us, tauus, Vs, tauvs = tb2bd_reflectors(b, kd,
+                                                          pipeline=pipeline)
         pu, pw = _bidiag_phases(d_c, e_c, b.dtype)
         d, e = jnp.abs(d_c), jnp.abs(e_c)
         if not want_vectors:

@@ -335,16 +335,23 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band, Vs, Ts = he2hb_distributed(a, grid, nb=nb)
     # he2hbGather analogue: replicate the (cheap) band for the local chase
     band = jax.device_put(band, grid.replicated())
-    out = hb2st(band, kd=nb, want_vectors=want_vectors,
-                pipeline=chase_pipeline)
     if not want_vectors:
-        d, e = out
+        d, e = hb2st(band, kd=nb, want_vectors=False,
+                     pipeline=chase_pipeline)
         # values-only always takes sterf — D&C inherently carries vectors
         # (merge z-couplings ARE eigenvector rows), exactly why the reference
         # routes no-vector solves to sterf too (heev.cc:208-215)
         lam = sterf(d, e)
         return lam * factor, None
-    d, e, Q2 = out
+    # vectors: the chase tape is the cheap O(n² kd) part and replays
+    # replicated; the Q2 accumulation — 97% of the profiled vectors time —
+    # shards over mesh rows with zero collectives (round-5; was replicated)
+    from ..linalg.eig import hb2st_reflectors
+
+    d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
+                                        pipeline=chase_pipeline)
+    e = jnp.abs(e_c)
+    Q2 = hb2st_q_distributed(Vcs, tcs, e_c, band.shape[-1], grid)
     if method_eig == "dc":
         # distributed D&C: the merge basis-update gemms ride the mesh
         lam, Zt = _stedc(d, e, grid=grid)
@@ -361,6 +368,53 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     # block; unmtr_he2hb.cc)
     Z = unmtr_he2hb_distributed(Vs, Ts, Z, grid, conj_q=False)
     return lam * factor, Z
+
+
+@lru_cache(maxsize=16)
+def _hb2st_q_shard_fn(mesh, n: int, npad: int):
+    """Row-sharded chase-vectors accumulation (the ~97%-of-time phase of the
+    distributed two-stage vectors path, PERF_CPU.md): the reflector tape
+    (Vs, taus) is replicated — it is the cheap O(n²) part — and each device
+    accumulates its own row block of Q2 via ``sweep_accumulate(Q0=rows)``,
+    building its identity block locally from iota (no host-side O(n²) eye
+    is ever materialized).  Every update is a column operation, so the
+    module contains ZERO collectives; the reference reaches the same shape
+    by redistributing Z to 1-D rows for unmtr_hb2st (heev.cc:193-205)."""
+    from ..linalg.householder import sweep_accumulate
+
+    nproc = mesh.size
+    rl = npad // nproc
+
+    def local_fn(Vs, taus, phase):
+        row0 = lax.axis_index(AX).astype(jnp.int32) * rl
+        rows = row0 + lax.broadcasted_iota(jnp.int32, (rl, n), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (rl, n), 1)
+        q0 = (rows == cols).astype(Vs.dtype)
+        q = sweep_accumulate(Vs, taus, n, Vs.shape[-1], Q0=q0)
+        return q * phase[None, :]
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(None), P(None), P(None)),
+                       out_specs=P(AX, None), check_vma=False)
+    return jax.jit(fn)
+
+
+def _sweep_q_distributed(Vs, taus, phase, n: int, grid: ProcessGrid):
+    """Row-sharded sweep accumulation with a column-phase postmultiply —
+    shared by the hb2st Q2 and the tb2bd U2/V2 builds."""
+    nproc = grid.p * grid.q
+    npad = -(-n // nproc) * nproc
+    Q = _hb2st_q_shard_fn(grid.mesh, n, npad)(Vs, taus,
+                                              phase.astype(Vs.dtype))
+    return Q[:n]
+
+
+def hb2st_q_distributed(Vs, taus, e_c, n: int, grid: ProcessGrid):
+    """Q2 of the hb2st chase, rows sharded on the flattened mesh."""
+    from ..linalg.eig import _phase_vector
+
+    return _sweep_q_distributed(Vs, taus, _phase_vector(e_c.astype(Vs.dtype)),
+                                n, grid)
 
 
 @lru_cache(maxsize=16)
@@ -497,11 +551,25 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band, Uf, Vf = ge2tb_distributed(a, grid, nb=nb)
     band = jax.device_put(band, grid.replicated())
     sq = band[:k, :k]
-    if k > 2:
-        out = tb2bd(sq, nb, want_vectors=want_vectors,
+    if k > 2 and want_vectors:
+        # reflector-level chase (replicated, the cheap part) + BOTH vector
+        # accumulations sharded over mesh rows with zero collectives
+        # (round 5 — the same 97%-phase split as the heev chase)
+        from ..linalg.svd import _bidiag_phases as _phases
+        from ..linalg.svd import tb2bd_reflectors
+
+        d_c, e_c, Us, tauus, Vcs, tauvs = tb2bd_reflectors(
+            sq, nb, pipeline=chase_pipeline)
+        pu, pw = _phases(d_c, e_c, a.dtype)
+        d, e = jnp.abs(d_c), jnp.abs(e_c)
+        U2 = _sweep_q_distributed(Us, tauus, pu, k, grid)
+        V2 = _sweep_q_distributed(Vcs, tauvs, pw, k, grid)
+        VT2 = jnp.conj(V2).T
+    elif k > 2:
+        out = tb2bd(sq, nb, want_vectors=False,
                     pipeline=chase_pipeline)
         d, e = out[0], out[1]
-        U2, VT2 = (out[2], out[3]) if want_vectors else (None, None)
+        U2, VT2 = None, None
     else:
         d_c = jnp.diagonal(sq)
         e_c = jnp.diagonal(sq, offset=1)

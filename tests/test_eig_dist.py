@@ -225,3 +225,52 @@ class TestStage1Sharding:
         s_d = np.linalg.svd(np.asarray(band), compute_uv=False)
         s_s = np.linalg.svd(a, compute_uv=False)
         assert np.max(np.abs(s_d - s_s)) / s_s[0] < 1e-12
+
+
+class TestShardedChaseVectors:
+    """Round-5: the hb2st Q2 accumulation — 97% of the profiled distributed
+    vectors time — shards over mesh rows instead of replicating."""
+
+    def test_matches_replicated_accumulation(self):
+        import numpy as np
+        from slate_tpu.linalg.eig import hb2st, hb2st_reflectors, he2hb
+        from slate_tpu.parallel import ProcessGrid
+        from slate_tpu.parallel.eig_dist import hb2st_q_distributed
+
+        rng = np.random.default_rng(11)
+        n, kd = 64, 8
+        A = rng.standard_normal((n, n)).astype(np.float32)
+        A = (A + A.T) / 2
+        band, _, _ = he2hb(jnp.asarray(A), None, nb=kd)
+        d_r, e_r, Q2_r = hb2st(band, kd=kd, want_vectors=True)
+        d, e_c, Vs, taus = hb2st_reflectors(band, kd=kd)
+        grid = ProcessGrid(2, 4)
+        Q2_s = hb2st_q_distributed(Vs, taus, e_c, n, grid)
+        assert np.abs(np.asarray(d) - np.asarray(d_r)).max() < 1e-6
+        assert np.abs(np.asarray(Q2_s) - np.asarray(Q2_r)).max() < 1e-5
+
+    def test_zero_collectives_and_row_sharding(self):
+        import numpy as np
+        import re
+        from slate_tpu.linalg.eig import he2hb, hb2st_reflectors
+        from slate_tpu.parallel import ProcessGrid
+        from slate_tpu.parallel.eig_dist import _hb2st_q_shard_fn
+
+        rng = np.random.default_rng(12)
+        n, kd = 64, 8
+        A = rng.standard_normal((n, n)).astype(np.float32)
+        A = (A + A.T) / 2
+        band, _, _ = he2hb(jnp.asarray(A), None, nb=kd)
+        _, e_c, Vs, taus = hb2st_reflectors(band, kd=kd)
+        grid = ProcessGrid(2, 4)
+        from slate_tpu.linalg.eig import _phase_vector
+        phase = _phase_vector(e_c.astype(Vs.dtype))
+        compiled = _hb2st_q_shard_fn(grid.mesh, n, n).lower(
+            Vs, taus, phase).compile()
+        hlo = compiled.as_text()
+        for coll in ("all-reduce", "all-gather", "collective-permute",
+                     "reduce-scatter", "all-to-all"):
+            assert coll not in hlo, f"unexpected collective {coll}"
+        # the row-block operand is genuinely 1/8-sharded
+        args = re.findall(r"f32\[8,64\]", hlo)
+        assert args, "expected (n/8, n) row-sharded operand in the module"
