@@ -32,8 +32,9 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      hegv, hesv, hetrf, hetrs, norm1est, pbsv, pbtrf, pbtrs,
                      pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri,
                      potrs, stedc, stedc_deflate, stedc_merge, stedc_secular,
-                     stedc_solve, stedc_sort, stedc_z_vector, steqr, steqr2,
-                     sterf, svd, svd_vals, syev, sygst, sygv, sysv, sytrf,
+                     stedc_solve, stedc_sort, stedc_z_vector, stein, steqr,
+                     steqr2, sterf, sterf_bisect, svd, svd_vals, syev, sygst,
+                     sygv, sysv, sytrf,
                      sytrs, tb2bd, tbsm, tbsm_pivots, tbsmPivots, trcondest,
                      trtri, trtrm, unmbr_ge2tb,
                      unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)

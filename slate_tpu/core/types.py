@@ -182,13 +182,15 @@ class MethodLU(_StrEnum):
 
 
 class MethodEig(_StrEnum):
-    """Tridiagonal eigensolver (enums.hh MethodEig: QR iteration vs divide & conquer)."""
+    """Tridiagonal eigensolver (enums.hh MethodEig:359-365)."""
 
     Auto = "auto"
-    QR = "qr"       # steqr
-    DC = "dc"       # stedc
-    Bisection = "bisection"
-    MRRR = "mrrr"
+    QR = "qr"       # steqr — real implicit-shift QR iteration
+    DC = "dc"       # stedc — divide & conquer (the Auto performance path)
+    Bisection = "bisection"   # sterf_bisect values + stein vectors — the
+                              # reference marks this "not yet implemented"
+                              # (enums.hh:363); implemented here
+    MRRR = "mrrr"   # unimplemented in the reference too; routes to DC
 
 
 class MethodSVD(_StrEnum):

@@ -17,6 +17,7 @@ from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr,
 from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest
+from .sturm import stein, sterf_bisect
 from .band import (BandLU, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs,
                    tbsm, tbsm_pivots, tbsmPivots)
 from .indefinite import (HermitianFactors, hesv, hetrf, hetrs, sysv, sytrf,

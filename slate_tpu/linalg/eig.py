@@ -87,7 +87,9 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
         lam, z = heev_distributed(
             a, grid, nb=default_band_nb(n, opts),
             want_vectors=want_vectors,
-            method_eig="qr" if opts.method_eig == MethodEig.QR else "dc",
+            method_eig={MethodEig.QR: "qr",
+                        MethodEig.Bisection: "bisection"}.get(
+                            opts.method_eig, "dc"),
             chase_pipeline=chase_pipeline)
         return (lam, z) if want_vectors else (lam, None)
     if method == "two_stage" and n < 8:
@@ -109,8 +111,18 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
                         # explicit QR-iteration request (O(n²)·gemm sweeps —
                         # the compatibility method, like the reference)
                         lam, Zt = steqr(d, e)
+                    elif opts.method_eig == MethodEig.Bisection:
+                        # bisection values + batched inverse iteration
+                        # vectors — the method the reference declares "not
+                        # yet implemented" (enums.hh:363), completed here
+                        from .sturm import stein, sterf_bisect
+
+                        lam = sterf_bisect(d, e)
+                        Zt = stein(d, e, lam)
                     else:
                         # Auto/DC: divide & conquer, the performance path
+                        # (MRRR also lands here — unimplemented in the
+                        # reference too; D&C is the graceful stand-in)
                         lam, Zt = stedc(d, e)
                     with timers.time("heev::unmtr_hb2st"):
                         z = jnp.matmul(Q2, Zt.astype(Q2.dtype),

