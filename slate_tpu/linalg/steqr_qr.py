@@ -24,13 +24,16 @@ TPU re-design (this module):
   (exactly the reference's design point), the per-sweep gemm touches local
   rows only, and the compiled module contains zero collectives.
 
-Complexity note, stated honestly: with vectors this formulation spends
-O(n²) MXU flops per sweep (vs LAPACK's O(n·w) scalar rotation applies),
-~O(n⁴)/MXU-rate total.  That is the price of keeping the update on the
-systolic array; it is the right trade at moderate n, and the performance
-path at scale remains stedc (divide & conquer, ``linalg/stedc.py``) — the
-same split the reference makes (steqr is its compatibility/QR-method path,
-used at top level only when MethodEig::QR is requested).
+Complexity note, stated honestly: with vectors a sweep costs rows·W² MXU
+flops, where W is the smallest power-of-two bucket covering the active
+window [l, m] (vs LAPACK's O(rows·W) scalar rotation applies) — the gemm
+runs over the active columns only, so the late small windows of a
+deflating iteration cost W², not n².  Summed over a full solve this is
+O(n³)-class with an extra bucket-width factor; the price of keeping the
+update on the systolic array.  The performance path at scale remains
+stedc (divide & conquer, ``linalg/stedc.py``) — the same split the
+reference makes (steqr is its compatibility/QR-method path, used at top
+level only when MethodEig::QR is requested).
 """
 
 from __future__ import annotations
@@ -211,6 +214,18 @@ def steqr_qr(d, e, Z: Optional[jax.Array] = None, *,
     else:
         Z0 = jnp.zeros((1, 1), rdt)
 
+    # power-of-two window buckets for the Z update: a sweep only rotates
+    # columns [l, m], so the gemm runs over the smallest bucket covering the
+    # active window instead of all n columns — the late, small windows of a
+    # deflating iteration cost W² instead of n² (the same blocking idea as
+    # LAPACK's lasr applying rotations to the active columns only)
+    buckets = []
+    w = 64
+    while w < n:
+        buckets.append(w)
+        w *= 2
+    buckets.append(n)
+
     def cond(state):
         d, e, Zc, it = state
         return (it < max_sweeps) & jnp.any(_deflate(d, e) != 0)
@@ -222,9 +237,25 @@ def steqr_qr(d, e, Z: Optional[jax.Array] = None, *,
         shift = _wilkinson(d, e, m)
         d2, e2, cs, ss = _sweep(d, e, l, m, shift)
         if accumulate:
-            Q = _sweep_q(cs, ss)
-            Zc = jnp.matmul(Zc, Q.astype(Zc.dtype),
-                            precision=lax.Precision.HIGHEST)
+            wsize = m + 1 - l              # columns touched: [l, m]
+            bidx = jnp.int32(0)
+            for i, W in enumerate(buckets[1:], start=1):
+                bidx = jnp.where(wsize > buckets[i - 1], jnp.int32(i), bidx)
+
+            def make_branch(W):
+                def branch(Zc, cs, ss, l):
+                    s0 = jnp.minimum(l, n - W)
+                    csw = lax.dynamic_slice(cs, (s0,), (W - 1,))
+                    ssw = lax.dynamic_slice(ss, (s0,), (W - 1,))
+                    Qw = _sweep_q(csw, ssw)
+                    Zw = lax.dynamic_slice(Zc, (0, s0), (Zc.shape[0], W))
+                    Zw = jnp.matmul(Zw, Qw.astype(Zc.dtype),
+                                    precision=lax.Precision.HIGHEST)
+                    return lax.dynamic_update_slice(Zc, Zw, (0, s0))
+                return branch
+
+            Zc = lax.switch(bidx, [make_branch(W) for W in buckets],
+                            Zc, cs, ss, l)
         d = jnp.where(any_active, d2, d)
         e = jnp.where(any_active, e2, e)
         return d, e, Zc, it + 1
