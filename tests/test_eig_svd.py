@@ -209,3 +209,19 @@ def test_bdsqr_tgk_values_large(rng):
     got = np.asarray(linalg.bdsqr(jnp.asarray(d), jnp.asarray(e))[0])
     assert np.max(np.abs(got - ref)) / ref[0] < 1e-13
     assert np.all(got >= 0) and np.all(np.diff(got) <= 0)
+
+
+def test_bdsqr_bisect_vectors_large(rng):
+    """Bisect+stein vectors above the dense threshold: eps-level
+    reconstruction without assembling the dense SVD (round 5)."""
+    k = 600
+    d = np.abs(rng.standard_normal(k)) + 0.5
+    e = rng.standard_normal(k - 1)
+    B = np.diag(d) + np.diag(e, 1)
+    S, U, VT = linalg.bdsqr(jnp.asarray(d), jnp.asarray(e),
+                            want_vectors=True, method="bisect")
+    S, U, VT = np.asarray(S), np.asarray(U), np.asarray(VT)
+    assert np.all(np.diff(S) <= 0)
+    assert np.abs(U @ np.diag(S) @ VT - B).max() < 1e-10
+    assert np.abs(U.T @ U - np.eye(k)).max() < 1e-10
+    assert np.abs(VT @ VT.T - np.eye(k)).max() < 1e-10

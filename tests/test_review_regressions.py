@@ -82,13 +82,22 @@ def test_norm_blocks_capped_in_bytes():
     assert bn32 % 128 == 0
 
 
-def test_bdsqr_bisect_with_vectors_rejected(rng):
-    """Round-4 review: method='bisect' is values-only; silently remapping to
-    the dense path would defeat a caller bounding memory/time."""
-    d = np.abs(rng.standard_normal(16)) + 1
-    e = rng.standard_normal(15) * 0.1
-    with pytest.raises(slate.SlateError):
-        slate.bdsqr(d, e, want_vectors=True, method="bisect")
+def test_bdsqr_bisect_with_vectors(rng):
+    """Round-4 review pinned method='bisect' as values-only (silently
+    remapping to dense would defeat a caller bounding memory/time).  Round 5
+    IMPLEMENTED the vectors path — Golub–Kahan bisection + stein batched
+    inverse iteration (the bdsvdx route).  Honest cost note: with vectors
+    the per-sweep QR makes it O(k³)-class like the dense path (structured
+    as batched solves + gemms); the O(k²)/O(k) bound the pin protected
+    still holds for values-only bisection."""
+    k = 16
+    d = np.abs(rng.standard_normal(k)) + 1
+    e = rng.standard_normal(k - 1) * 0.1
+    S, U, VT = slate.bdsqr(d, e, want_vectors=True, method="bisect")
+    B = np.diag(d) + np.diag(e, 1)
+    S, U, VT = np.asarray(S), np.asarray(U), np.asarray(VT)
+    assert np.abs(U @ np.diag(S) @ VT - B).max() < 1e-10
+    assert np.abs(U.T @ U - np.eye(k)).max() < 1e-10
 
 
 def test_complex_sysv_not_exposed_in_lapack_skin():
