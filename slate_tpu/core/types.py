@@ -195,9 +195,11 @@ class MethodEig(_StrEnum):
 
 class MethodSVD(_StrEnum):
     Auto = "auto"
-    QR = "qr"       # bdsqr
-    DC = "dc"
-    Bisection = "bisection"
+    QR = "qr"         # bdsqr-style (auto: bisect values / dense vectors)
+    DC = "dc"         # divide-and-conquer-class dense solve (gesdd/QDWH)
+    Bisection = "bisection"   # GK bisection values + stein vectors —
+                              # unimplemented in the reference, implemented
+                              # here (linalg/svd.py bdsqr method='bisect')
 
 
 class MethodCholQR(_StrEnum):

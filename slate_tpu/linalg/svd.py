@@ -71,10 +71,11 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
             with timers.time("svd::bdsqr"):
                 # MethodSVD.Bisection -> GK bisection values + stein
                 # inverse-iteration vectors (implemented here; the
-                # reference leaves the method unimplemented)
-                bd_method = ("bisect"
-                             if opts.method_svd == MethodSVD.Bisection
-                             else "auto")
+                # reference leaves the method unimplemented).  DC -> the
+                # dense divide-and-conquer-class solve at any size.
+                bd_method = {MethodSVD.Bisection: "bisect",
+                             MethodSVD.DC: "dense"}.get(
+                                 opts.method_svd, "auto")
                 Sv, Ub, VTb = bdsqr(d, e, opts, want_vectors=want_vectors,
                                     method=bd_method)
             if want_vectors:
