@@ -549,8 +549,12 @@ def run_steqr(p, slate):
     lam, Q = np.asarray(lam, np.float64), np.asarray(Q, np.float64)
     err1 = _rel(np.linalg.norm(T @ Q - Q * lam[None, :]), np.linalg.norm(T))
     err2 = np.linalg.norm(Q.T @ Q - np.eye(n)) / n
-    # ~3 sweeps/eigenvalue x n^2-class rotation+gemm work: 6 n^3 job model
-    return _result(p, max(err1, err2), 6.0 * n ** 3, t)
+    # ~3 sweeps/eigenvalue x n^2-class rotation+gemm work: 6 n^3 job model.
+    # Accuracy envelope of accumulated QR iteration is O(sweeps*eps) =
+    # O(n*eps); the suite-wide tol carries sqrt(n), so the gate needs the
+    # other sqrt(n) factor
+    return _result(p, max(err1, err2), 6.0 * n ** 3, t,
+                   tol_mult=max(1.0, n ** 0.5) / 10.0)
 
 
 @_routine("hegv", "eig")
