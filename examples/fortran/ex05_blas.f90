@@ -1,0 +1,42 @@
+! ex05: BLAS from Fortran through the generated iso_c_binding module
+! (reference examples/fortran/ex05_blas.f90 is the same exercise).
+!
+!   gfortran tools/fortran/slate_tpu.f90 examples/fortran/ex05_blas.f90 \
+!     -L native -lslate_c_api -Wl,-rpath,native -o ex05 && ./ex05
+program ex05_blas
+  use slate_tpu
+  use iso_c_binding
+  implicit none
+  integer(c_int64_t), parameter :: m = 23, n = 17, k = 31
+  real(c_double) :: A(m, k), B(k, n), C(m, n), R(m, n)
+  real(c_double) :: alpha, beta, err
+  integer(c_int) :: info
+  integer :: i, j, p
+
+  alpha = 1.5d0
+  beta = -0.5d0
+  call random_number(A); A = A - 0.5d0
+  call random_number(B); B = B - 0.5d0
+  call random_number(C); C = C - 0.5d0
+  R = C
+
+  info = slate_init()
+  if (info /= 0) stop 'slate_init failed'
+  info = slate_dgemm('n', 'n', m, n, k, alpha, A, m, B, k, beta, C, m)
+  if (info /= 0) stop 'slate_dgemm failed'
+
+  err = 0d0
+  do j = 1, int(n)
+     do i = 1, int(m)
+        R(i, j) = beta * R(i, j)
+        do p = 1, int(k)
+           R(i, j) = R(i, j) + alpha * A(i, p) * B(p, j)
+        end do
+        err = max(err, abs(R(i, j) - C(i, j)))
+     end do
+  end do
+  call slate_finalize()
+  print '(a, es10.3)', 'ex05 gemm max err = ', err
+  if (err > 1d-10) stop 'ex05 FAILED'
+  print '(a)', 'ex05 OK'
+end program ex05_blas

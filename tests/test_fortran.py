@@ -65,3 +65,27 @@ def test_fortran_module_in_sync_with_header():
         committed = f.read()
     assert gen.emit(decls) == committed, \
         "slate_tpu.f90 is stale — rerun tools/fortran/gen_fortran.py"
+
+
+@pytest.mark.skipif(_fc() is None, reason="no Fortran compiler")
+def test_fortran_blas_example(tmp_path):
+    """examples/fortran/ex05_blas.f90 (reference examples/fortran/ex05):
+    Fortran gemm through the generated module + embedded runtime."""
+    build = subprocess.run(["make", "-C", _NATIVE, "libslate_c_api.so"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    exe = str(tmp_path / "ex05f")
+    fc = subprocess.run(
+        [_fc(), os.path.join(_ROOT, "tools", "fortran", "slate_tpu.f90"),
+         os.path.join(_ROOT, "examples", "fortran", "ex05_blas.f90"),
+         "-J", str(tmp_path), "-L", _NATIVE, "-lslate_c_api",
+         f"-Wl,-rpath,{_NATIVE}", "-o", exe],
+        capture_output=True, text=True, timeout=120)
+    assert fc.returncode == 0, fc.stderr[-2000:]
+    env = dict(os.environ)
+    env.update({"SLATE_TPU_ROOT": _ROOT, "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""})
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert run.returncode == 0, run.stdout[-2000:] + run.stderr[-2000:]
+    assert "ex05 OK" in run.stdout
