@@ -59,7 +59,8 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
 
         S, U, VT = svd_distributed(a, grid, nb=default_band_nb(min(m, n), opts),
                                    want_vectors=want_vectors,
-                                   chase_pipeline=chase_pipeline)
+                                   chase_pipeline=chase_pipeline,
+                                   method_svd=str(opts.method_svd))
         return S, (U if want_u else None), (VT if want_vt else None)
     if method == "two_stage":
         with trace_block("svd_two_stage", m=m, n=n):

@@ -499,12 +499,14 @@ def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
 
 
 def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
-                    want_vectors: bool = True, chase_pipeline: bool = False):
+                    want_vectors: bool = True, chase_pipeline: bool = False,
+                    method_svd: str = "auto"):
     """Distributed SVD over the (p, q) mesh (src/svd.cc pipeline).
 
     Returns (S descending, U or None, VT or None); U/VT come back sharded.
     Wide inputs run on the conjugate transpose (U/VT swap), like the
-    reference's LQ pre-step (svd.cc:224+).
+    reference's LQ pre-step (svd.cc:224+).  ``method_svd='bisection'``
+    solves the bidiagonal stage by GK bisection (+ stein vectors).
     """
     from ..linalg.eig import _safe_scale
     from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd
@@ -539,7 +541,8 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
             _, R = tsqr_distributed(A, grid)
             S, _, _ = svd_distributed(R[:n, :n], grid, nb=nb,
                                       want_vectors=False,
-                                      chase_pipeline=chase_pipeline)
+                                      chase_pipeline=chase_pipeline,
+                                      method_svd=method_svd)
             return S, None, None
         from .qr_dist import geqrf_distributed
 
@@ -585,7 +588,8 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         pu, pw = _bidiag_phases(d_c, e_c, a.dtype)
         d, e = jnp.abs(d_c), jnp.abs(e_c)
         U2, VT2 = jnp.diag(pu), jnp.conj(jnp.diag(pw)).T
-    S, Ub, VTb = bdsqr(d, e, want_vectors=want_vectors)
+    bd_method = {"bisection": "bisect", "dc": "dense"}.get(method_svd, "auto")
+    S, Ub, VTb = bdsqr(d, e, want_vectors=want_vectors, method=bd_method)
     if not want_vectors:
         return S * factor, None, None
     # U = Q_u [U2 Ub; 0],  VT = (VTb VT2) Q_v^H — sharded reflector sweeps
