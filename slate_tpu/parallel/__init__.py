@@ -37,7 +37,7 @@ from .eig_dist import (heev_distributed, hegv_distributed, svd_distributed,
                        unmtr_he2hb_distributed, steqr_distributed)
 from .inverse import (trtri_distributed, trtrm_distributed, potri_distributed,
                       getri_distributed, gecondest_distributed,
-                      pocondest_distributed)
+                      pocondest_distributed, trcondest_distributed)
 from .band_dist import (pbtrf_distributed, pbtrs_distributed, pbsv_distributed,
                         tbsm_distributed, gbtrf_distributed, gbtrs_distributed,
                         gbsv_distributed, dense_to_band_lower,

@@ -356,6 +356,16 @@ def _plantr_distributed(dt, norm, uplo, diag, a):
     return float(norm_distributed(_norm_kind(norm), aj, _grid, uplo=u))
 
 
+def _ptrcon_distributed(dt, norm, uplo, diag, a):
+    from .parallel import trcondest_distributed
+
+    return float(trcondest_distributed(
+        _jnp(np.asarray(a, dtype=dt)), _grid,
+        lower=str(uplo).lower().startswith("l"),
+        unit_diagonal=str(diag).lower().startswith("u"),
+        norm_kind=_norm_kind(norm)))
+
+
 def _pgecon_distributed(dt, norm, lu_, ipiv, anorm):
     from .core.types import Norm
     from .parallel import gecondest_distributed
@@ -433,6 +443,7 @@ _DISTRIBUTED = {
     # gathers to host either way, so the elementwise fill runs through the
     # shared single-device driver (a device round-trip would be pure cost)
     "lantr": _plantr_distributed,
+    "trcon": _ptrcon_distributed,
     "gecon": _pgecon_distributed,
     "pocon": _ppocon_distributed,
     "getri": _pgetri_distributed,

@@ -292,6 +292,14 @@ class TestRound5Skins:
             assert vu == 3.0   # unit diag replaces the stored 3s with 1s
             Z = sapi.pslaset("g", 8, 8, 2.0, 5.0)
             assert Z[0, 0] == 5.0 and Z[0, 1] == 2.0
+            T2 = np.triu(M) + n * np.eye(n, dtype=np.float32)
+            rc3 = sapi.pstrcon("1", "u", "n", T2)
+            Tinv = np.linalg.inv(T2.astype(np.float64))
+            ref3 = 1.0 / (np.abs(T2).sum(axis=0).max()
+                          * np.abs(Tinv).sum(axis=0).max())
+            assert 0.2 * ref3 < rc3 < 5 * ref3
+            rci = sapi.pstrcon("i", "u", "u", T2)
+            assert 0.0 < rci <= 1.0
         finally:
             sapi.gridexit()
 
