@@ -117,7 +117,10 @@ def _chol_blocked(a):
     blocks (BENCH_NOTES.md); the fused op runs only at the <=256 base."""
     n = a.shape[-1]
     if n <= _CHOL_BASE:
-        return lax.linalg.cholesky(a)
+        # lower-triangle-only reference (XLA Cholesky ignores the upper
+        # triangle): callers may hand in blocks whose upper triangle is
+        # stale because the trailing updates maintain only the lower half
+        return lax.linalg.cholesky(a, symmetrize_input=False)
     h = n // 2
     a11, a21, a22 = a[..., :h, :h], a[..., h:, :h], a[..., h:, h:]
     l11 = _chol_blocked(a11)
@@ -171,12 +174,30 @@ def _potrf_tiled_fn(n: int, nb: int, dtype_str: str, inv_trsm: bool = False):
                         Lkk, L[k1:n, k0:k1], left_side=False, lower=True,
                         conjugate_a=True, transpose_a=True)
                 L = L.at[k1:n, k0:k1].set(panel)
-                # trailing update (≅ internal::herk, potrf.cc:136-148 — the hot loop).
-                # Full-width update keeps the trailing block Hermitian so later panels
-                # read valid data without re-symmetrizing.
-                upd = jnp.matmul(panel, jnp.conj(panel.T),
-                                 precision=lax.Precision.HIGHEST)
-                L = L.at[k1:n, k1:n].add(-upd)
+                # trailing update (≅ internal::herk, potrf.cc:136-148 — the hot
+                # loop).  Blocked herk: one trapezoidal gemm per block-column
+                # group on/below the diagonal instead of the full panel·panelᴴ
+                # square — flop factor (1 + 1/S)/2 of the square at S groups,
+                # i.e. 0.56x at the S=8 cap (exact halving when few columns
+                # remain).  S is capped so the unrolled program stays O(8·nt)
+                # ops, and beyond the same nt=32 unroll bound solvers.py caps
+                # at, S=1 degenerates to the single full-square update (whose
+                # Hermitian add keeps both triangles valid, as before).  Only
+                # the lower triangle of the trailing block is maintained;
+                # every later read (diagonal-block Cholesky, sub-diagonal
+                # panels) references the lower half only (_chol_blocked
+                # factors with symmetrize_input=False).
+                rem = nt - (k + 1)
+                S = min(rem, 8) if nt <= 32 else 1
+                for i in range(S):
+                    jb0 = k + 1 + (i * rem) // S
+                    jb1 = k + 1 + ((i + 1) * rem) // S
+                    j0, j1 = jb0 * nb, min(jb1 * nb, n)
+                    s = j0 - k1
+                    upd = jnp.matmul(panel[s:, :],
+                                     jnp.conj(panel[s:j1 - k1, :].T),
+                                     precision=lax.Precision.HIGHEST)
+                    L = L.at[j0:n, j0:j1].add(-upd)
         return jnp.tril(L)
 
     return jax.jit(fn)
