@@ -41,7 +41,8 @@ from ..core.exceptions import SlateError
 from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodGels, Op, Options, Side
 from ..utils.trace import trace_block
-from .chol import _chol_info
+from ..ops.blas3 import gram
+from .chol import _chol_blocked, _chol_info
 
 
 @dataclasses.dataclass
@@ -236,9 +237,10 @@ def cholqr(A, opts=None):
         return jnp.matmul(x, W, precision=lax.Precision.HIGHEST)
 
     def one_pass(x):
-        G = jnp.matmul(jnp.conj(jnp.swapaxes(x, -1, -2)), x,
-                       precision=lax.Precision.HIGHEST)
-        L = lax.linalg.cholesky(G)
+        # herk-halved Gram + recursive blocked factor of the n x n result
+        # (the fused XLA Cholesky serializes at large n, BENCH_NOTES.md)
+        G = gram(x)
+        L = _chol_blocked(G)
         info = _chol_info(L)
         return q_from_chol(L, x), jnp.conj(jnp.swapaxes(L, -1, -2)), info
 
@@ -246,10 +248,8 @@ def cholqr(A, opts=None):
         # shifted retry (stabilized CholeskyQR): shift Gram by ~11(mn+n^2) eps ||A||^2
         eps = jnp.finfo(x.dtype).eps
         shift = 11.0 * (m * n + n * (n + 1)) * eps * (jnp.linalg.norm(x) ** 2)
-        G = jnp.matmul(jnp.conj(x.T), x,
-                       precision=lax.Precision.HIGHEST) + shift * jnp.eye(
-                           n, dtype=x.dtype)
-        L = lax.linalg.cholesky(G)
+        G = gram(x) + shift * jnp.eye(n, dtype=x.dtype)
+        L = _chol_blocked(G)
         return q_from_chol(L, x), jnp.conj(L.T)
 
     with trace_block("cholqr", m=m, n=n):
@@ -291,9 +291,11 @@ def _gels_csne(a, b):
     squared-Gram route is in trouble, so no shifted retry is attempted here.
     """
     ah = jnp.conj(jnp.swapaxes(a, -1, -2))
-    G = jnp.matmul(ah, a, precision=lax.Precision.HIGHEST)
+    # herk-halved Gram (the dominant 2mn^2 of the whole job) + recursive
+    # blocked factor (the fused XLA Cholesky serializes at large n)
+    G = gram(a)
     w = jnp.matmul(ah, b, precision=lax.Precision.HIGHEST)
-    L = lax.linalg.cholesky(G)
+    L = _chol_blocked(G)
     info = _chol_info(L)
 
     def normal_solve(rhs):

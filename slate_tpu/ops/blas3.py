@@ -101,6 +101,33 @@ def herk(alpha, A, beta, C, uplo):
     return _rank_k_update(_c(alpha, up) * up, beta, C, uplo, real_diag=True)
 
 
+def gram(x, strips: int = 8, precision=None):
+    """x^H x as block-column strips on/below the diagonal, mirrored to the
+    full Hermitian result — flop factor (1 + 1/S)/2 of the naive square
+    matmul (the herk halving; reference internal_herk's triangle scope).
+    Each strip product keeps the full contraction dim, so MXU utilization
+    stays gemm-class; the mirror assembly is O(n^2) copies.  The result is
+    exactly Hermitian by construction (the naive matmul is only
+    approximately so in floating point)."""
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    n = x.shape[-1]
+    xh = jnp.conj(jnp.swapaxes(x, -1, -2))
+    # keep strips at least 128 columns so the per-strip gemms stay
+    # lane-aligned; S=1 degenerates to the plain full product
+    S = max(1, min(strips, n // 128))
+    if S <= 1:
+        return jnp.matmul(xh, x, precision=precision)
+    G = jnp.zeros(x.shape[:-2] + (n, n), dtype=x.dtype)
+    for i in range(S):
+        j0, j1 = (i * n) // S, ((i + 1) * n) // S
+        blk = jnp.matmul(xh[..., j0:, :], x[..., :, j0:j1],
+                         precision=precision)
+        G = G.at[..., j0:, j0:j1].set(blk)
+    low = jnp.tril(G)
+    return low + jnp.conj(jnp.swapaxes(jnp.tril(G, -1), -1, -2))
+
+
 def syr2k(alpha, A, B, beta, C, uplo):
     up = jnp.matmul(A, jnp.swapaxes(B, -1, -2))
     up = _c(alpha, up) * up + _c(alpha, up) * jnp.matmul(B, jnp.swapaxes(A, -1, -2))

@@ -28,8 +28,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.exceptions import slate_assert
-from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..linalg.chol import _chol_blocked
+from ..ops import blas3
+from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
 
 
 # ---------------------------------------------------------------------------
@@ -434,9 +435,10 @@ def _cholqr_fn(mesh, precision):
     world = mesh.devices.size
 
     def local(a):
-        # per-shard Gram contribution; psum = the listReduce tree over all ranks
-        g = lax.psum(jnp.matmul(jnp.conj(a.T), a, precision=precision), axes)
-        Rg = jnp.conj(lax.linalg.cholesky(g).T)     # g = R^H R
+        # per-shard Gram contribution (herk-halved strips); psum = the
+        # listReduce tree over all ranks
+        g = lax.psum(blas3.gram(a, precision=precision), axes)
+        Rg = jnp.conj(_chol_blocked(g).T)           # g = R^H R
 
         def gram_path(_):
             q = lax.linalg.triangular_solve(Rg, a, left_side=False, lower=False)
