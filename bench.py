@@ -113,6 +113,24 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+# Child-side soft deadline (set from BENCH_CHILD_BUDGET_SEC in __main__).
+# Round-5 wedge forensics: the heev/svd children at n=16384 need ~9 forced
+# eigh/svd calls under the chain protocol — more than their per-config
+# timeout on the tunnel — so the parent SIGKILLed them mid-RPC, and a child
+# killed mid-execution is exactly the documented tunnel-wedge trigger (today:
+# getrf captured fresh at 08:35, the heev group timed out, every probe after
+# 09:20 hung).  The fix: children track a soft deadline 120 s inside the
+# parent timeout, never START a call whose estimated cost does not fit, and
+# emit a truncated-but-real measurement instead of dying.
+_CHILD_DEADLINE = None
+
+
+def _budget_left():
+    if _CHILD_DEADLINE is None:
+        return float("inf")
+    return _CHILD_DEADLINE - time.time()
+
+
 def child_probe():
     import jax
     import jax.numpy as jnp
@@ -145,31 +163,52 @@ def _chain_rate(body, a0, consts, k_small, k_large, flops_per_iter, repeats=3):
     from jax import lax
 
     def timed(k):
+        """(min timed sec, compile+warm sec).  Budget-aware: repeats stop
+        early when the next timed call would not fit inside the soft
+        deadline; with zero repeats the warm time stands in (compile-
+        inclusive, so the derived rate is an under-estimate, never inflated)."""
         fn = jax.jit(lambda c0, *cs: lax.fori_loop(
             0, k, lambda i, c: body(i, c, *cs), c0))
+        t0 = time.perf_counter()
         float(jnp_ravel0(fn(a0, *consts)))   # compile + warm (forced)
+        warm = time.perf_counter() - t0
         ts = []
         for j in range(repeats):
+            est = min(ts) if ts else warm
+            if _budget_left() < 1.3 * est + 10:
+                break
             c0 = a0 + (j + 1) * 1e-7
             float(jnp_ravel0(c0))            # materialize before the clock
             t0 = time.perf_counter()
             r = fn(c0, *consts)
             float(jnp_ravel0(r))             # fetch forces execution
             ts.append(time.perf_counter() - t0)
-        return min(ts)
+        return (min(ts) if ts else warm), warm
 
     def jnp_ravel0(x):
         return x.ravel()[0]
 
-    t_small = timed(k_small)
-    t_large = timed(k_large)
+    info = {}
+    t_small, warm_small = timed(k_small)
+    # cost of the large-chain round: one compile+warm (k_large iters) plus up
+    # to `repeats` timed calls, scaled from the small-chain reading
+    est_large = (t_small / k_small) * k_large * (repeats + 1) + 0.5 * warm_small
+    if _budget_left() < 1.2 * est_large + 10:
+        # not enough budget for the delta protocol: report the overhead-
+        # inclusive small-chain rate and SAY so, rather than risk the parent
+        # killing this child mid-RPC (the tunnel-wedge trigger)
+        per_iter = t_small / k_small
+        info["budget_truncated"] = f"k_large={k_large} skipped; rate is " \
+                                   f"overhead-inclusive from k={k_small}"
+        return flops_per_iter / per_iter / 1e9, per_iter, info
+    t_large, _ = timed(k_large)
     per_iter = (t_large - t_small) / (k_large - k_small)
     if per_iter <= 0:
         # short chains on fast ops can lose the delta to timing noise; fall
         # back to the overhead-inclusive total (always positive, and an
         # *under*-estimate of the rate — never an absurd number)
         per_iter = t_large / k_large
-    return flops_per_iter / per_iter / 1e9, per_iter
+    return flops_per_iter / per_iter / 1e9, per_iter, info
 
 
 def child_gemm(cpu_fallback):
@@ -193,9 +232,9 @@ def child_gemm(cpu_fallback):
         return slate_tpu.gemm(scale, c, b, 0.0, c)
 
     ks, kl = (2, 10) if cpu_fallback else (8, 136)
-    gflops, per_iter = _chain_rate(body, a, (b, scale), ks, kl, 2.0 * n**3)
+    gflops, per_iter, info = _chain_rate(body, a, (b, scale), ks, kl, 2.0 * n**3)
     _emit({"metric": f"gemm_f32hi_n{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter, **info})
 
 
 def child_potrf(cpu_fallback):
@@ -235,11 +274,11 @@ def child_potrf(cpu_fallback):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
         return slate_tpu.potrf(ap, opts=opts)[0]
 
-    gflops, per_iter = _chain_rate(body, a, (a,), 1, 3, n**3 / 3.0,
-                                   repeats=2)
+    gflops, per_iter, info = _chain_rate(body, a, (a,), 1, 3, n**3 / 3.0,
+                                         repeats=2)
     tag = "_invtrsm" if inv else ""
     _emit({"metric": f"potrf{tag}_f32_n{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter, **info})
 
 
 def child_getrf(cpu_fallback):
@@ -271,10 +310,10 @@ def child_getrf(cpu_fallback):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
         return slate_tpu.getrf(ap, opts=opts)[0]
 
-    gflops, per_iter = _chain_rate(body, a, (a,), 1, 3, 2.0 * n**3 / 3.0,
-                                   repeats=2)
+    gflops, per_iter, info = _chain_rate(body, a, (a,), 1, 3, 2.0 * n**3 / 3.0,
+                                         repeats=2)
     _emit({"metric": f"getrf_calu_f32_n{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter})
+           "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter, **info})
 
 
 def child_gels(cpu_fallback):
@@ -305,9 +344,9 @@ def child_gels(cpu_fallback):
         return bc + 1e-6 * X[0, 0]
 
     flops = 2.0 * n * n * (m - n / 3.0) + 4.0 * m * n * nrhs
-    gflops, sec = _chain_rate(body, b, (a,), 1, 3, flops, repeats=2)
+    gflops, sec, info = _chain_rate(body, b, (a,), 1, 3, flops, repeats=2)
     _emit({"metric": f"gels_cholqr_f32_{m}x{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "m": m, "n": n, "sec_per_call": sec})
+           "unit": "GFLOP/s", "m": m, "n": n, "sec_per_call": sec, **info})
 
 
 def child_heev(cpu_fallback):
@@ -331,10 +370,10 @@ def child_heev(cpu_fallback):
         return c + 1e-6 * lam
 
     c0 = jnp.zeros((n,), jnp.float32)
-    gflops, sec = _chain_rate(body, c0, (a,), 1, 2, 4.0 * n**3 / 3.0,
-                              repeats=2)
+    gflops, sec, info = _chain_rate(body, c0, (a,), 1, 2, 4.0 * n**3 / 3.0,
+                                    repeats=2)
     _emit({"metric": f"heev_vals_f32_n{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
+           "unit": "GFLOP/s", "n": n, "sec_per_call": sec, **info})
 
 
 def child_svd(cpu_fallback):
@@ -356,10 +395,10 @@ def child_svd(cpu_fallback):
         return c + 1e-6 * s
 
     c0 = jnp.zeros((n,), jnp.float32)
-    gflops, sec = _chain_rate(body, c0, (a,), 1, 2, 8.0 * n**3 / 3.0,
-                              repeats=2)
+    gflops, sec, info = _chain_rate(body, c0, (a,), 1, 2, 8.0 * n**3 / 3.0,
+                                    repeats=2)
     _emit({"metric": f"svd_vals_f32_n{n}_gflops", "value": round(gflops, 1),
-           "unit": "GFLOP/s", "n": n, "sec_per_call": sec})
+           "unit": "GFLOP/s", "n": n, "sec_per_call": sec, **info})
 
 
 def child_norm(cpu_fallback):
@@ -402,12 +441,13 @@ def child_norm(cpu_fallback):
     # iter time.  Exact pass count depends on XLA fusing the perturb-add
     # into the norm reads (then 3); the 1/4 attribution is the conservative
     # end, stated here so the number is interpretable.
-    gflops, per_iter = _chain_rate(body, c0, (a,), ks, kl, 4.0 * 2.0 * n * n)
+    gflops, per_iter, info = _chain_rate(body, c0, (a,), ks, kl,
+                                         4.0 * 2.0 * n * n)
     _emit({"metric": f"genorm_fro{tag}_f32_n{n}_gflops",
            "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter,
            "note": "fro+one+perturb per iter (~4 passes); rate = fro model "
-                   "over 1/4 iter time"})
+                   "over 1/4 iter time", **info})
 
 
 def _direct_rate(run, make_input, fetch, flops, repeats=3):
@@ -415,17 +455,28 @@ def _direct_rate(run, make_input, fetch, flops, repeats=3):
     internal while_loops): warm once, then time ``run`` on a freshly
     perturbed input each repeat, forcing with a one-element fetch.  The
     ~70 ms tunnel dispatch overhead is included, so rates are honest
-    under-estimates for second-scale jobs."""
+    under-estimates for second-scale jobs.  Budget-aware like _chain_rate:
+    repeats stop when the next call would not fit the soft deadline; with
+    zero repeats the compile-inclusive warm time stands in (noted)."""
+    info = {}
+    t0 = time.perf_counter()
     fetch(run(make_input(0)))          # compile + warm
+    warm = time.perf_counter() - t0
     ts = []
     for j in range(repeats):
+        est = min(ts) if ts else warm
+        if _budget_left() < 1.3 * est + 10:
+            info["budget_truncated"] = (
+                f"{len(ts)}/{repeats} repeats ran"
+                + ("" if ts else "; rate is compile-inclusive warm time"))
+            break
         x = make_input(j + 1)
         fetch(x)                       # materialize before the clock
         t0 = time.perf_counter()
         fetch(run(x))
         ts.append(time.perf_counter() - t0)
-    sec = min(ts)
-    return flops / sec / 1e9, sec
+    sec = min(ts) if ts else warm
+    return flops / sec / 1e9, sec, info
 
 
 def child_potrf_la(cpu_fallback):
@@ -455,13 +506,13 @@ def child_potrf_la(cpu_fallback):
     def make_input(j):
         return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
 
-    gflops, sec = _direct_rate(
+    gflops, sec, info = _direct_rate(
         lambda x: potrf_pipelined(x, grid, nb=nb),
         make_input, lambda r: float(r.ravel()[0]), n**3 / 3.0,
         repeats=2)
     _emit({"metric": f"potrf_lookahead_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n, "nb": nb,
-           "sec_per_call": sec})
+           "sec_per_call": sec, **info})
 
 
 def child_f64gemm(cpu_fallback):
@@ -487,12 +538,12 @@ def child_f64gemm(cpu_fallback):
         return gemm_f64emu(c, b, alpha=scale)
 
     ks, kl = (1, 3) if cpu_fallback else (2, 8)
-    gflops, per_iter = _chain_rate(body, a, (b, scale), ks, kl, 2.0 * n**3,
-                                   repeats=2)
+    gflops, per_iter, info = _chain_rate(body, a, (b, scale), ks, kl,
+                                         2.0 * n**3, repeats=2)
     _emit({"metric": f"gemm_f64emu_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter,
            "note": "double-precision-class result (Ozaki s=7); honest fp64 "
-                   "vs fp64 ratio"})
+                   "vs fp64 ratio", **info})
 
 
 def child_gesvir(cpu_fallback):
@@ -521,13 +572,13 @@ def child_gesvir(cpu_fallback):
         return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
 
     flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
-    gflops, sec = _direct_rate(run, make_input,
-                               lambda r: float(r.ravel()[0]), flops,
-                               repeats=2)
+    gflops, sec, info = _direct_rate(run, make_input,
+                                     lambda r: float(r.ravel()[0]), flops,
+                                     repeats=2)
     _emit({"metric": f"gesv_f64ir_n{n}_gflops", "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "nrhs": nrhs, "sec_per_call": sec,
            "note": "double-class forward error on f32 hardware; one host "
-                   "sync per solve (lax.while_loop IR)"})
+                   "sync per solve (lax.while_loop IR)", **info})
 
 
 def child_heev2s(cpu_fallback):
@@ -556,32 +607,37 @@ def child_heev2s(cpu_fallback):
     def make_input(j):
         return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
 
-    gflops, sec = _direct_rate(run, make_input,
-                               lambda r: float(r.ravel()[0]),
-                               4.0 * n**3 / 3.0, repeats=2)
+    gflops, sec, info = _direct_rate(run, make_input,
+                                     lambda r: float(r.ravel()[0]),
+                                     4.0 * n**3 / 3.0, repeats=2)
 
     # phase split (heev.cc:126-212's timer-level-2 analogue): time each
     # stage once, fetch-forced, so a single chip capture carries the
     # he2hb / hb2st / sterf breakdown alongside the end-to-end rate
     from slate_tpu.linalg.eig import hb2st, he2hb, sterf
 
+    # the phase split costs roughly one more end-to-end run (plus compiles);
+    # skip it rather than let the parent kill this child mid-RPC
     phases = {}
-    t0 = time.perf_counter()
-    band, Vs, Ts = he2hb(a)
-    float(band.ravel()[0])
-    phases["he2hb_s"] = round(time.perf_counter() - t0, 3)
-    t0 = time.perf_counter()
-    d, e = hb2st(band, want_vectors=False, pipeline=not cpu_fallback)
-    float(d.ravel()[0])
-    phases["hb2st_s"] = round(time.perf_counter() - t0, 3)
-    t0 = time.perf_counter()
-    lam = sterf(d, e)
-    float(lam.ravel()[0])
-    phases["sterf_s"] = round(time.perf_counter() - t0, 3)
+    if _budget_left() < 1.5 * sec + 60:
+        phases["skipped"] = "insufficient budget after rate measurement"
+    else:
+        t0 = time.perf_counter()
+        band, Vs, Ts = he2hb(a)
+        float(band.ravel()[0])
+        phases["he2hb_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        d, e = hb2st(band, want_vectors=False, pipeline=not cpu_fallback)
+        float(d.ravel()[0])
+        phases["hb2st_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        lam = sterf(d, e)
+        float(lam.ravel()[0])
+        phases["sterf_s"] = round(time.perf_counter() - t0, 3)
 
     _emit({"metric": f"heev_two_stage_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
-           "sec_per_call": sec, "phases_first_call": phases})
+           "sec_per_call": sec, "phases_first_call": phases, **info})
 
 
 def child_svd2s(cpu_fallback):
@@ -607,25 +663,28 @@ def child_svd2s(cpu_fallback):
     def make_input(j):
         return a + (1e-6 * j) * jnp.eye(n, dtype=a.dtype)
 
-    gflops, sec = _direct_rate(run, make_input,
-                               lambda r: float(r.ravel()[0]),
-                               8.0 * n**3 / 3.0, repeats=2)
+    gflops, sec, info = _direct_rate(run, make_input,
+                                     lambda r: float(r.ravel()[0]),
+                                     8.0 * n**3 / 3.0, repeats=2)
 
     from slate_tpu.linalg.svd import bdsqr, ge2tb, tb2bd
 
     phases = {}
-    t0 = time.perf_counter()
-    d, e, _, _ = ge2tb(a, chase_pipeline=not cpu_fallback)
-    float(d.ravel()[0])
-    phases["ge2tb_s"] = round(time.perf_counter() - t0, 3)
-    t0 = time.perf_counter()
-    S, _, _ = bdsqr(d, e)
-    float(S.ravel()[0])
-    phases["bdsqr_s"] = round(time.perf_counter() - t0, 3)
+    if _budget_left() < 1.5 * sec + 60:
+        phases["skipped"] = "insufficient budget after rate measurement"
+    else:
+        t0 = time.perf_counter()
+        d, e, _, _ = ge2tb(a, chase_pipeline=not cpu_fallback)
+        float(d.ravel()[0])
+        phases["ge2tb_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        S, _, _ = bdsqr(d, e)
+        float(S.ravel()[0])
+        phases["bdsqr_s"] = round(time.perf_counter() - t0, 3)
 
     _emit({"metric": f"svd_two_stage_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
-           "sec_per_call": sec, "phases_first_call": phases})
+           "sec_per_call": sec, "phases_first_call": phases, **info})
 
 
 CHILDREN = {
@@ -658,6 +717,10 @@ def _run_child(name, cpu_fallback, timeout):
     # backfilled as the kernel's last-known-good)
     for knob in ("BENCH_NORM_IMPL", "BENCH_POTRF_INVTRSM"):
         env.pop(knob, None)
+    # soft deadline 120 s inside the hard timeout: the child finishes (or
+    # truncates) and exits on its own instead of being SIGKILLed mid-RPC,
+    # which is what wedges the tunnel for every later child
+    env["BENCH_CHILD_BUDGET_SEC"] = str(max(60, int(timeout) - 120))
     if cpu_fallback:
         # JAX_PLATFORMS=cpu alone is NOT enough: the ambient sitecustomize hook
         # registers the real-TPU 'axon' PJRT plugin and hangs on a wedged
@@ -912,6 +975,9 @@ if __name__ == "__main__":
     ns = ap.parse_args()
     if ns.child:
         cpu_fb = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+        budget = os.environ.get("BENCH_CHILD_BUDGET_SEC")
+        if budget:
+            _CHILD_DEADLINE = time.time() + float(budget)
         CHILDREN[ns.child](cpu_fb)
     else:
         if ns.only:

@@ -1,53 +1,89 @@
 #!/bin/bash
-# Tunnel-recovery watcher: probe the TPU tunnel at a low duty cycle; the
-# moment it answers, capture the outstanding bench configs into
-# BENCH_LKG.json in VERDICT-r3 priority order, then the block-size sweeps.
-# Single tunnel user by design.  Each bench.py invocation is a separate
-# parent (fresh probe) so one wedged child cannot strand the later groups.
+# Tunnel-recovery watcher v2 (round 5): single tunnel owner; captures the
+# outstanding bench configs into BENCH_LKG.json in VERDICT-r4 priority order.
+#
+# Changes vs v1 after the 09:20 wedge forensics:
+# - every group (and every sweep child) is gated by its OWN probe, so a
+#   tunnel that dies mid-round makes the watcher WAIT instead of burning
+#   the remaining groups as CPU-fallback rows (today's r4 group lost 19 min
+#   that way);
+# - bench children are budget-aware now (BENCH_CHILD_BUDGET_SEC): they emit
+#   a truncated measurement and exit instead of being SIGKILLed mid-RPC —
+#   the kill is the documented wedge trigger, and the heev/svd group doing
+#   exactly that at 08:35-09:20 is what took the tunnel down;
+# - cheap/robust configs first (norm, potrf and its closers), the
+#   minutes-per-call eig/SVD configs last;
+# - resumable: completed steps are recorded in .tpu_watch_done so a watcher
+#   restart (session handoff) does not redo captures.
 cd "$(dirname "$0")/.."
-for i in $(seq 1 400); do
-  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" 2>/dev/null; then
-    echo "[tpu_watch] tunnel healthy at attempt $i ($(date -u +%H:%M:%S))"
-    # (a) the two-rounds-overdue getrf two-level CALU number
-    BENCH_DEADLINE_SEC=1800 timeout 2000 python bench.py --only getrf 2>&1 | tail -1
-    echo "[tpu_watch] getrf done ($(date -u +%H:%M:%S))"
-    # (b) heev/svd at the BASELINE-scale configs
-    BENCH_DEADLINE_SEC=3000 timeout 3200 python bench.py --only heev,svd 2>&1 | tail -1
-    echo "[tpu_watch] heev/svd done ($(date -u +%H:%M:%S))"
-    # (c) the round-4 additions: lookahead potrf, f64 story, two-stage timing
-    BENCH_DEADLINE_SEC=7000 timeout 7300 python bench.py --only potrf_la,f64gemm,gesvir,heev2s,svd2s 2>&1 | tail -1
-    echo "[tpu_watch] r4 configs done ($(date -u +%H:%M:%S))"
-    # (d) refresh the five round-3 captures
-    BENCH_DEADLINE_SEC=2400 timeout 2700 python bench.py --only gemm,norm,potrf,gels 2>&1 | tail -1
-    echo "[tpu_watch] refresh done ($(date -u +%H:%M:%S)); sweeps"
-    for cfg in "2048 512" "1024 256" "2048 128"; do
-      set -- $cfg
-      echo "[sweep] getrf nb=$1 ib=$2"
-      BENCH_GETRF_NB=$1 BENCH_GETRF_IB=$2 timeout 1500 \
-        python bench.py --child getrf 2>&1 | tail -1
-    done
-    for nb in 1024 4096; do
-      echo "[sweep] potrf nb=$nb"
-      BENCH_POTRF_NB=$nb timeout 1200 \
-        python bench.py --child potrf 2>&1 | tail -1
-    done
-    echo "[sweep] potrf inverse-apply panel"
-    BENCH_POTRF_INVTRSM=1 timeout 1200 \
-      python bench.py --child potrf 2>&1 | tail -1
-    echo "[sweep] norm via plain XLA reduction (A/B vs Pallas)"
-    BENCH_NORM_IMPL=xla timeout 1200 \
-      python bench.py --child norm 2>&1 | tail -1
-    for nb in 1024 4096; do
-      echo "[sweep] potrf_la nb=$nb"
-      BENCH_POTRF_LA_NB=$nb timeout 1200 \
-        python bench.py --child potrf_la 2>&1 | tail -1
-    done
-    echo "[profile] potrf jax.profiler trace"
+STATE=.tpu_watch_done
+
+log() { echo "[tpu_watch] $* ($(date -u +%H:%M:%S))"; }
+probe_ok() {
+  timeout 90 python -c \
+    "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" 2>/dev/null
+}
+wait_tunnel() {  # $1 = max probes, 150 s apart
+  local i
+  for i in $(seq 1 "$1"); do
+    probe_ok && return 0
+    sleep 150
+  done
+  return 1
+}
+done_step() { grep -qxF "$1" "$STATE" 2>/dev/null; }
+mark_done() { echo "$1" >> "$STATE"; }
+
+run_group() {  # $1 name, $2 configs, $3 deadline, $4 timeout
+  done_step "$1" && return 0
+  wait_tunnel 40 || { log "tunnel never opened for $1"; return 1; }
+  log "start $1 ($2)"
+  BENCH_DEADLINE_SEC=$3 timeout "$4" python bench.py --only "$2" 2>&1 | tail -1
+  log "done $1"
+  mark_done "$1"
+}
+
+run_child() {  # $1 step name, $2 timeout, $3 config, rest = env pairs
+  done_step "$1" && return 0
+  probe_ok || { log "tunnel down; skip $1 this pass"; return 1; }
+  log "start $1"
+  local step=$1 to=$2 cfg=$3; shift 3
+  env "$@" BENCH_CHILD_BUDGET_SEC=$((to - 120)) timeout "$to" \
+    python bench.py --child "$cfg" 2>&1 | tail -1
+  mark_done "$step"
+}
+
+# one outer loop so a group whose tunnel-wait expired gets another chance
+for pass in 1 2 3; do
+  log "pass $pass"
+  # (a) VERDICT #2/#3: the potrf-closer family + the norm fix, all fast
+  run_group g_norm_potrf "norm,potrf" 1800 2000
+  run_child s_potrf_nb1024 900 potrf BENCH_POTRF_NB=1024
+  run_child s_potrf_nb4096 900 potrf BENCH_POTRF_NB=4096
+  run_child s_potrf_inv 900 potrf BENCH_POTRF_INVTRSM=1
+  run_child s_norm_xla 900 norm BENCH_NORM_IMPL=xla
+  # (b) round-4 additions that have never touched the chip
+  run_group g_la_f64_ir "potrf_la,f64gemm,gesvir" 2400 2600
+  run_child s_potrf_la_nb1024 1000 potrf_la BENCH_POTRF_LA_NB=1024
+  # (c) two-stage pipelines at n=8192 with phase splits (VERDICT #4)
+  run_group g_twostage "heev2s,svd2s" 4000 4300
+  # (d) BASELINE-scale heev/svd (budget-truncating children land a number)
+  run_group g_heev_svd "heev,svd" 3200 3400
+  # (e) getrf blocking sweeps (reconnect with the round-2 6.8 TF/s evidence)
+  run_child s_getrf_nb2048_ib512 1500 getrf BENCH_GETRF_NB=2048 BENCH_GETRF_IB=512
+  run_child s_getrf_nb1024_ib256 1500 getrf BENCH_GETRF_NB=1024 BENCH_GETRF_IB=256
+  run_child s_getrf_nb4096_ib512 1500 getrf BENCH_GETRF_NB=4096 BENCH_GETRF_IB=512
+  # (f) refresh the round-3 captures that already have good cached numbers
+  run_group g_refresh "gemm,gels" 1500 1700
+  # (g) potrf profile trace for the lookahead analysis
+  if ! done_step s_profile && probe_ok; then
+    log "start s_profile"
     timeout 1200 python tools/tpu_profile_potrf.py 2>&1 | tail -2
-    echo "[tpu_watch] all done ($(date -u +%H:%M:%S))"
+    mark_done s_profile
+  fi
+  if [ "$(grep -c . "$STATE" 2>/dev/null || echo 0)" -ge 14 ]; then
+    log "all 14 steps complete"
     exit 0
   fi
-  sleep 150
 done
-echo "[tpu_watch] gave up after 400 attempts"
-exit 1
+log "passes exhausted; $(grep -c . "$STATE" 2>/dev/null || echo 0)/14 steps done"
