@@ -302,7 +302,8 @@ def child_getrf(cpu_fallback):
     # BENCH_GETRF_NB / BENCH_GETRF_IB override the outer/inner blocking for
     # on-chip sweeps (VERDICT r2 next-step #2 asks for nb in {256,512,1024})
     import os as _os
-    opts = {"method_lu": "calu",
+    panel = _os.environ.get("BENCH_GETRF_PANEL", "tournament")
+    opts = {"method_lu": "calu", "lu_panel": panel,
             "block_size": int(_os.environ.get("BENCH_GETRF_NB", 2048)),
             "inner_blocking": int(_os.environ.get("BENCH_GETRF_IB", 256))}
 
@@ -312,7 +313,9 @@ def child_getrf(cpu_fallback):
 
     gflops, per_iter, info = _chain_rate(body, a, (a,), 1, 3, 2.0 * n**3 / 3.0,
                                          repeats=2)
-    _emit({"metric": f"getrf_calu_f32_n{n}_gflops", "value": round(gflops, 1),
+    tag = "" if panel == "tournament" else f"_{panel}"
+    _emit({"metric": f"getrf_calu{tag}_f32_n{n}_gflops",
+           "value": round(gflops, 1),
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter, **info})
 
 
@@ -715,7 +718,8 @@ def _run_child(name, cpu_fallback, timeout):
     # variant-tagged measurement into BENCH_LKG.json under the default
     # config key (it would be scored against the default baseline and
     # backfilled as the kernel's last-known-good)
-    for knob in ("BENCH_NORM_IMPL", "BENCH_POTRF_INVTRSM"):
+    for knob in ("BENCH_NORM_IMPL", "BENCH_POTRF_INVTRSM",
+                 "BENCH_GETRF_PANEL"):
         env.pop(knob, None)
     # soft deadline 120 s inside the hard timeout: the child finishes (or
     # truncates) and exits on its own instead of being SIGKILLed mid-RPC,

@@ -258,6 +258,15 @@ class Options:
                                     # MXU throughput for ~cond(Lkk)^2 local
                                     # error; bench sweep knob, linalg/chol.py)
     hold_local_workspace: bool = False  # parity only
+    lu_panel: str = "tournament"    # CALU pivot-selection scheme: "tournament"
+                                    # (binary merge tree of batched LUs,
+                                    # getrf_tntpiv.cc) or "pp" (one partial-
+                                    # pivot LU of the ib-wide subpanel selects
+                                    # the pivot rows — ~6x fewer sequential
+                                    # elimination steps per panel on TPU, where
+                                    # each tournament level is a column-
+                                    # sequential batched LU; A/B knob for the
+                                    # getrf bench)
     print_verbose: int = 0          # Option::PrintVerbose (enums.hh:477-488)
     print_edgeitems: int = 16
     print_width: int = 10

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.exceptions import SlateError
+from ..core.exceptions import SlateError, slate_assert
 from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodLU, Options, Target
 from ..utils.trace import trace_block
@@ -335,14 +335,24 @@ def _tournament_panel(panel, nb):
 
 
 @lru_cache(maxsize=32)
-def _getrf_tntpiv_fn(m: int, n: int, nb: int, ib: int, dtype_str: str):
+def _getrf_tntpiv_fn(m: int, n: int, nb: int, ib: int, dtype_str: str,
+                     panel_scheme: str = "tournament"):
     """Two-level CALU (getrf_tntpiv.cc:161-230 + its ib inner blocking).
 
     Tournament merge flops scale as (panel width)² per candidate row, so
     pivot selection runs on narrow ib-wide subpanels while the trailing
     update stays an nb-wide MXU gemm — the same nb/ib split the reference
     uses (Option::InnerBlocking), which took the n=16384 bench config from
-    ~6.5 to the flat-panel tournament's missing third of peak."""
+    ~6.5 to the flat-panel tournament's missing third of peak.
+
+    ``panel_scheme="pp"`` selects pivots with ONE partial-pivot LU of the
+    ib-wide subpanel instead of the merge tree: the tournament's log2(m/ib)
+    levels are each a column-sequential batched LU (~6 x ib sequential
+    elimination steps per panel at the bench shape), while a single panel
+    LU is ib steps — the selection quality of classic partial pivoting at a
+    sixth of the sequential depth.  (The round-2 finding that fused
+    lax.linalg.lu "does not finish" was for the FULL n-wide matrix, not an
+    ib-wide panel.)"""
     kmax = min(m, n)
     nt = -(-kmax // nb)
 
@@ -351,7 +361,14 @@ def _getrf_tntpiv_fn(m: int, n: int, nb: int, ib: int, dtype_str: str):
         block factor + L21, then update outer-panel cols [c1,upto) only."""
         w = c1 - c0
         panel = A[c0:m, c0:c1]
-        winners = _tournament_panel(panel, w)          # local indices into panel
+        if panel_scheme == "pp":
+            # classic partial pivoting on the subpanel: the permutation's
+            # first w entries are the rows the elimination promoted to the
+            # top — exactly the pivot rows, discarding the factor
+            _, _, pperm = lax.linalg.lu(panel)
+            winners = pperm[:w]
+        else:
+            winners = _tournament_panel(panel, w)      # local indices into panel
         # dirty-rows-only exchange (permuteRows analogue): winners move to
         # the top w window slots and the displaced occupants fill the
         # vacated winner slots — ≤ 2w rows move, vs the full-matrix
@@ -423,8 +440,11 @@ def getrf_tntpiv(A, opts=None):
     m, n = a.shape[-2:]
     nb = min(opts.block_size, m, n)
     ib = max(1, min(opts.inner_blocking, nb))
+    slate_assert(opts.lu_panel in ("tournament", "pp"),
+                 f"lu_panel must be 'tournament' or 'pp', got {opts.lu_panel!r}")
     with trace_block("getrf_tntpiv", m=m, n=n):
-        out, perm = _getrf_tntpiv_fn(m, n, nb, ib, str(a.dtype))(a)
+        out, perm = _getrf_tntpiv_fn(m, n, nb, ib, str(a.dtype),
+                                     opts.lu_panel)(a)
     info = _lu_info(jnp.diagonal(out, axis1=-2, axis2=-1))
     return write_back(A, out), perm, info
 

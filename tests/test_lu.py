@@ -76,6 +76,40 @@ def test_getrf_tntpiv_two_level(rng, m, n, nb, ib):
     assert sorted(np.asarray(perm).tolist()) == list(range(m))
 
 
+@pytest.mark.parametrize("m,n,nb,ib", [(40, 40, 10, 5), (64, 64, 16, 8),
+                                       (70, 50, 16, 4)])
+def test_getrf_tntpiv_pp_panel(rng, m, n, nb, ib):
+    """CALU with the partial-pivot panel scheme (Options.lu_panel="pp"): one
+    panel LU selects the pivots instead of the merge tree.  Same factorization
+    contract — and on square full-rank inputs the selected pivot SET per
+    subpanel equals classic partial pivoting's."""
+    a = _gen(rng, m, n)
+    lu_arr, perm, info = linalg.getrf(
+        a, {"method_lu": "calu", "block_size": nb, "inner_blocking": ib,
+            "lu_panel": "pp"})
+    assert int(info) == 0
+    assert _check_lu(a, lu_arr, perm) < 1e-11
+    assert sorted(np.asarray(perm).tolist()) == list(range(m))
+
+
+def test_getrf_tntpiv_pp_matches_lapack_pivots(rng):
+    """With ib == nb == n (one panel), pp-CALU must reproduce classic partial
+    pivoting exactly — same permutation, same factor."""
+    n = 24
+    a = _gen(rng, n, n)
+    lu_arr, perm, info = linalg.getrf(
+        a, {"method_lu": "calu", "block_size": n, "inner_blocking": n,
+            "lu_panel": "pp"})
+    import scipy.linalg as sla
+
+    lu_ref, piv = sla.lu_factor(a)
+    perm_ref = np.arange(n)
+    for i, p in enumerate(piv):
+        perm_ref[[i, p]] = perm_ref[[p, i]]
+    assert np.array_equal(np.asarray(perm), perm_ref)
+    assert np.allclose(np.asarray(lu_arr), lu_ref, atol=1e-12)
+
+
 @pytest.mark.parametrize("method", ["partialpiv", "calu"])
 def test_gesv(rng, method):
     n, nrhs = 24, 3

@@ -69,8 +69,11 @@ for pass in 1 2 3; do
   run_group g_twostage "heev2s,svd2s" 4000 4300
   # (d) BASELINE-scale heev/svd (budget-truncating children land a number)
   run_group g_heev_svd "heev,svd" 3200 3400
-  # (e) getrf blocking sweeps (reconnect with the round-2 6.8 TF/s evidence)
+  # (e) getrf blocking sweeps (reconnect with the round-2 6.8 TF/s evidence);
+  #     the pp-panel A/B targets the tournament's sequential-depth hypothesis
+  run_child s_getrf_pp 1500 getrf BENCH_GETRF_PANEL=pp
   run_child s_getrf_nb2048_ib512 1500 getrf BENCH_GETRF_NB=2048 BENCH_GETRF_IB=512
+  run_child s_getrf_nb2048_ib128 1500 getrf BENCH_GETRF_NB=2048 BENCH_GETRF_IB=128
   run_child s_getrf_nb1024_ib256 1500 getrf BENCH_GETRF_NB=1024 BENCH_GETRF_IB=256
   run_child s_getrf_nb4096_ib512 1500 getrf BENCH_GETRF_NB=4096 BENCH_GETRF_IB=512
   # (f) refresh the round-3 captures that already have good cached numbers
@@ -81,9 +84,9 @@ for pass in 1 2 3; do
     timeout 1200 python tools/tpu_profile_potrf.py 2>&1 | tail -2
     mark_done s_profile
   fi
-  if [ "$(grep -c . "$STATE" 2>/dev/null || echo 0)" -ge 14 ]; then
-    log "all 14 steps complete"
+  if [ "$(grep -c . "$STATE" 2>/dev/null || echo 0)" -ge 16 ]; then
+    log "all 16 steps complete"
     exit 0
   fi
 done
-log "passes exhausted; $(grep -c . "$STATE" 2>/dev/null || echo 0)/14 steps done"
+log "passes exhausted; $(grep -c . "$STATE" 2>/dev/null || echo 0)/16 steps done"
