@@ -1,0 +1,252 @@
+"""Distributed bulge chase: the hb2st pipelined schedule sharded over a mesh.
+
+The reference confines stage 2 to rank 0 (src/hb2st.cc scheduling consumed on
+one process; src/heev.cc:137-160 gathers the band there), and rounds 1-4 of
+this repo mirrored that: ``heev_distributed`` replicated the band and every
+device replayed the same chase.  This module goes past the reference: the
+band's column range is partitioned into P contiguous segments, each device
+runs only the chase fronts whose window anchor falls in its segment, and
+neighbors reconcile through two tiny ``ppermute`` exchanges per round:
+
+- a (2b+1)x(2b+1) boundary-square DELTA in each direction.  Concurrent
+  fronts write element-disjoint footprints (the schedule spaces live fronts
+  2b-1 apart - the same commutativity the reference's thread scheduler and
+  our batched single-device rounds rely on), so neighbor copies of the
+  overlap reconcile by pure addition;
+- at most one CROSSING reflector (v, tau, s): a front advances b columns
+  per round and fronts are 2b-1 apart, so per boundary per round at most
+  one front hops segments, carrying its v_prev to the next owner.
+
+Collective volume is O(b^2 + b) per round - independent of n - versus the
+O(n * b) band replication the rank-0 design ships once.  Per-device window
+work drops from the full front set (~n/2b batched windows per round) to
+~n/(2bP).
+
+Schedule (identical to linalg/eig.py:_hb2st_chase_pipelined): sweep s runs
+hebr1 at round t=2s and its hebr2/hebr3 step r (window anchor
+j = (t-2s)b+1+s, i = j+b) at round t = 2s+r-1; front ownership is by the
+anchor column j.  hebr1 ownership is by the sweep's r=1 anchor j = s+1, so
+the hebr1 -> first-hebr2 handoff (same round, shared v0) never crosses a
+boundary; the window's one-column reach below s+1 is why tiles carry a
+single extra left column.
+
+Results match the single-device pipelined chase bit-for-bit in the same
+XLA configuration: same windows, same reflectors, same order per front
+(pinned by tests/test_chase_dist.py against _hb2st_chase_pipelined).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.exceptions import slate_assert
+from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+
+AX = (ROW_AXIS, COL_AXIS)                  # flattened device axis
+
+
+def _shift_right(x, P_):
+    """Each device receives its LEFT neighbor's value (device 0: zeros)."""
+    return lax.ppermute(x, AX, [(i, i + 1) for i in range(P_ - 1)])
+
+
+def _shift_left(x, P_):
+    """Each device receives its RIGHT neighbor's value (device P-1: zeros)."""
+    return lax.ppermute(x, AX, [(i + 1, i) for i in range(P_ - 1)])
+
+
+@lru_cache(maxsize=16)
+def _chase_dist_fn(mesh, n: int, b: int, seg: int, want_vectors: bool,
+                   dtype_str: str):
+    """Build the jitted shard_map chase for static (mesh, n, b, seg)."""
+    from ..linalg.eig import _hebr1_window
+    from ..linalg import householder as hh
+
+    P_ = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    dt = jnp.dtype(dtype_str)
+    n_sweeps = max(n - 2, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    T = 2 * n_sweeps + m_max
+    B_loc = seg // (2 * b - 1) + 1          # max co-resident fronts/segment
+    S_cap = B_loc + 2                        # v_prev store keys (mod-S_cap)
+    M = seg + 4 * b + 4                      # local tile (real+halo+zero-land)
+    lz = seg + 2 * b + 2                     # zero-land anchor (local)
+    W_pad = P_ * seg + 4 * b + 4             # strip width (cols never sharded)
+    sq = 2 * b + 1                           # boundary-square edge
+    ar_b = jnp.arange(b)
+
+    def local_fn(strip):                     # (seg, W_pad): rows [c0, c0+seg)
+        p = lax.axis_index(AX)
+        c0 = p * seg
+        c1 = c0 + seg
+        g0 = jnp.maximum(c0 - 1, 0)          # tile origin (global)
+        # overlapping tile: left neighbor's tail row + my strip + the 2b-row
+        # right halo (one neighbor suffices: seg >= 2b+2), zero-padded up to
+        # the tile height (the tail rows are zero-land, zeroed below anyway)
+        prev_tail = _shift_right(strip[-1:], P_)
+        next_head = _shift_left(strip[: 2 * b], P_)
+        zpad = jnp.zeros((M + 1 - (1 + seg + 2 * b), W_pad), dt)
+        rows_ext = jnp.concatenate([prev_tail, strip, next_head, zpad], 0)
+        off = g0 - (c0 - 1)                  # 1 on device 0, else 0
+        tile = lax.dynamic_slice(rows_ext, (off, jnp.zeros_like(off)),
+                         (M, W_pad))
+        tile = lax.dynamic_slice(tile, (jnp.zeros_like(g0), g0), (M, M))
+        # zero everything past real+halo: the slice drags neighbor data into
+        # what must be this device's zero-land
+        re = c1 + 2 * b - g0
+        arM = jnp.arange(M)
+        keep = (arM < re)[:, None] & (arM < re)[None, :]
+        tile = jnp.where(keep, tile, jnp.zeros((), dt))
+        lL = 0                               # the tile origin IS the left
+        #                                      boundary square (g0 = c0-1,
+        #                                      clamped with c0 on device 0)
+        lR = c1 - 1 - g0                     # right boundary square (local)
+
+        stv0 = jnp.zeros((S_cap, b), dt)
+        stt0 = jnp.zeros((S_cap,), dt)
+        nvs = n_sweeps + 1 if want_vectors else 1
+        Vs0 = jnp.zeros((nvs, m_max, b), dt)
+        taus0 = jnp.zeros((nvs, m_max), dt)
+
+        def round_body(t, carry):
+            tile, stv, stt, Vs, taus = carry
+            snapL = lax.dynamic_slice(tile, (lL, lL), (sq, sq))
+            snapR = lax.dynamic_slice(tile, (lR, lR), (sq, sq))
+
+            # ---- hebr1: owned by the device of its r=1 anchor s0+1 -------
+            s0 = t // 2
+            start = (2 * s0 == t) & (s0 < n_sweeps)
+            own1 = start & (s0 + 1 >= c0) & (s0 + 1 < c1)
+            a1 = jnp.where(own1, s0 - g0, lz)
+            W1 = lax.dynamic_slice(tile, (a1, a1), (b + 1, b + 1))
+            W1, v0, tau0 = _hebr1_window(W1)
+            tile = lax.dynamic_update_slice(tile, W1, (a1, a1))
+            k0 = jnp.where(own1, s0 % S_cap, S_cap)      # OOB -> dropped
+            stv = stv.at[k0].set(v0, mode="drop")
+            stt = stt.at[k0].set(tau0, mode="drop")
+            if want_vectors:
+                sv = jnp.where(own1, s0, n_sweeps)
+                Vs = Vs.at[sv, 0].set(jnp.where(own1, v0, Vs[sv, 0]))
+                taus = taus.at[sv, 0].set(jnp.where(own1, tau0, taus[sv, 0]))
+
+            # ---- batched hebr2+hebr3 over my live fronts -----------------
+            # fronts at round t: sweep s at anchor j = t*b+1 - s*(2b-1),
+            # step r = t-2s+1; mine are the (<= B_loc) consecutive s with
+            # j in [c0, c1)
+            s_start = -((c1 - t * b - 2) // (2 * b - 1))
+            s_q = s_start + jnp.arange(B_loc)
+            j_q = t * b + 1 - s_q * (2 * b - 1)
+            r_q = t - 2 * s_q + 1
+            m_s = -(-(n - 1 - s_q) // b)
+            active = ((s_q >= 0) & (s_q < n_sweeps) & (r_q >= 1)
+                      & (r_q < m_s) & (j_q >= c0) & (j_q < c1))
+            li = jnp.where(active, j_q + b - g0, lz + b)
+            ljj = jnp.where(active, j_q - g0, lz)
+            vp = stv[s_q % S_cap]
+            tp = stt[s_q % S_cap]
+            rows = li[:, None] + ar_b[None, :]           # (B_loc, b)
+            cols = ljj[:, None] + ar_b[None, :]
+            Wb = tile[rows[:, :, None], cols[:, None, :]]
+            Wv = jnp.einsum("bij,bj->bi", Wb, vp)
+            Wb = Wb - tp[:, None, None] * Wv[:, :, None] * jnp.conj(vp)[:, None, :]
+            v, tau, _ = hh.larfg(Wb[:, :, 0])
+            vW = jnp.einsum("bi,bij->bj", jnp.conj(v), Wb)
+            Wb = Wb - jnp.conj(tau)[:, None, None] * v[:, :, None] * vW[:, None, :]
+            tile = tile.at[rows[:, :, None], cols[:, None, :]].set(Wb)
+            tile = tile.at[cols[:, :, None], rows[:, None, :]].set(
+                jnp.conj(jnp.swapaxes(Wb, -1, -2)))
+            Db = tile[rows[:, :, None], rows[:, None, :]]
+            Dv = jnp.einsum("bi,bij->bj", jnp.conj(v), Db)
+            Db = Db - jnp.conj(tau)[:, None, None] * v[:, :, None] * Dv[:, None, :]
+            Dw = jnp.einsum("bij,bj->bi", Db, v)
+            Db = Db - tau[:, None, None] * Dw[:, :, None] * jnp.conj(v)[:, None, :]
+            tile = tile.at[rows[:, :, None], rows[:, None, :]].set(Db)
+            kq = jnp.where(active, s_q % S_cap, S_cap)
+            stv = stv.at[kq].set(v, mode="drop")
+            stt = stt.at[kq].set(tau, mode="drop")
+            if want_vectors:
+                s_c = jnp.where(active, s_q, n_sweeps)
+                r_c = jnp.where(active, r_q, 0)
+                Vs = Vs.at[s_c, r_c].set(
+                    jnp.where(active[:, None], v, Vs[s_c, r_c]))
+                taus = taus.at[s_c, r_c].set(
+                    jnp.where(active, tau, taus[s_c, r_c]))
+
+            # ---- neighbor reconciliation ---------------------------------
+            dL = lax.dynamic_slice(tile, (lL, lL), (sq, sq)) - snapL
+            dR = lax.dynamic_slice(tile, (lR, lR), (sq, sq)) - snapR
+            crossing = active & (j_q >= c1 - b)          # at most one
+            cvalid = jnp.any(crossing).astype(jnp.int32)
+            cs = jnp.sum(jnp.where(crossing, s_q, 0))
+            cv = jnp.sum(jnp.where(crossing[:, None], v, 0), axis=0)
+            ct = jnp.sum(jnp.where(crossing, tau, 0))
+            # rightward: my dR + crossing reflector -> right neighbor
+            rdelta = _shift_right(dR, P_)
+            rv = _shift_right(cv, P_)
+            rt = _shift_right(ct, P_)
+            rs = _shift_right(cs, P_)
+            rvalid = _shift_right(cvalid, P_)
+            # leftward: my dL -> left neighbor
+            ldelta = _shift_left(dL, P_)
+            tile = lax.dynamic_update_slice(
+                tile, lax.dynamic_slice(tile, (lL, lL), (sq, sq)) + rdelta,
+                (lL, lL))
+            tile = lax.dynamic_update_slice(
+                tile, lax.dynamic_slice(tile, (lR, lR), (sq, sq)) + ldelta,
+                (lR, lR))
+            kin = jnp.where(rvalid == 1, rs % S_cap, S_cap)
+            stv = stv.at[kin].set(rv, mode="drop")
+            stt = stt.at[kin].set(rt, mode="drop")
+            return tile, stv, stt, Vs, taus
+
+        tile, stv, stt, Vs, taus = lax.fori_loop(
+            0, T, round_body, (tile, stv0, stt0, Vs0, taus0))
+
+        # owned diagonal + subdiagonal segments (global x in [c0, c1))
+        lx = jnp.arange(seg) + (c0 - g0)
+        d_loc = jnp.real(tile[lx, lx])
+        e_loc = tile[lx + 1, lx]             # e[x] = T[x+1, x]
+        if want_vectors:
+            Vs = lax.psum(Vs, AX)
+            taus = lax.psum(taus, AX)
+        return d_loc, e_loc, Vs, taus
+
+    out_specs = (P(AX), P(AX), P(None), P(None))
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(P(AX, None),),
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)
+
+
+def hb2st_chase_distributed(Afull: jax.Array, kd: int, grid: ProcessGrid,
+                            want_vectors: bool = False):
+    """Segment-parallel bulge chase over ``grid``'s flattened device list.
+
+    ``Afull``: the full Hermitian band matrix (dense storage, bandwidth
+    ``kd``), replicated on the host side like the rank-0 design's input.
+    Returns ``(d, e_complex, Vs, taus)`` matching
+    ``linalg.eig._hb2st_chase_pipelined`` (``Vs``/``taus`` are zeros when
+    ``want_vectors=False``).
+    """
+    n = Afull.shape[-1]
+    b = int(kd)
+    P_ = grid.size
+    slate_assert(b >= 2 and n > 2, "chase needs kd >= 2 and n > 2")
+    seg = -(-n // P_)
+    slate_assert(seg >= 2 * b + 2,
+                 f"segment {seg} too narrow for bandwidth {b} on {P_} devices"
+                 " (need n/P >= 2*kd+2); use the replicated chase")
+    W_pad = P_ * seg + 4 * b + 4
+    Ap = jnp.zeros((P_ * seg, W_pad), Afull.dtype)
+    Ap = Ap.at[:n, :n].set(Afull)
+    fn = _chase_dist_fn(grid.mesh, n, b, seg, bool(want_vectors),
+                        str(Afull.dtype))
+    d_all, e_all, Vs, taus = fn(Ap)
+    d = d_all[:n]
+    e_c = e_all[: n - 1]
+    n_sweeps = max(n - 2, 0)
+    return d, e_c, Vs[:n_sweeps], taus[:n_sweeps]

@@ -307,11 +307,18 @@ def ge2tb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
 
 def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                      want_vectors: bool = True, method_eig: str = "dc",
-                     chase_pipeline: bool = False):
+                     chase_pipeline: bool = False,
+                     chase_distributed: bool = False):
     """Distributed Hermitian eigensolve over the (p, q) mesh (src/heev.cc).
 
     Returns (ascending eigenvalues, Z or None); Z comes back sharded on the
     grid.  ``method_eig='dc'`` solves the tridiagonal with stedc.
+
+    ``chase_distributed=True`` runs stage 2 segment-parallel over the mesh
+    (parallel/chase_dist.py) instead of replicating the band chase on every
+    device — past the reference, which confines hb2st to rank 0
+    (heev.cc:137-160).  Requires n/P >= 2*nb+2 (falls back to the
+    replicated chase below that floor).
     """
     from ..linalg.eig import _safe_scale, hb2st, sterf
     from ..linalg.stedc import stedc as _stedc
@@ -335,9 +342,19 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band, Vs, Ts = he2hb_distributed(a, grid, nb=nb)
     # he2hbGather analogue: replicate the (cheap) band for the local chase
     band = jax.device_put(band, grid.replicated())
+    nband = band.shape[-1]
+    use_dist_chase = (chase_distributed and nb >= 2 and nband > 2
+                      and -(-nband // (grid.p * grid.q)) >= 2 * nb + 2)
+    if use_dist_chase:
+        from .chase_dist import hb2st_chase_distributed
     if not want_vectors:
-        d, e = hb2st(band, kd=nb, want_vectors=False,
-                     pipeline=chase_pipeline)
+        if use_dist_chase:
+            d, e_c, _, _ = hb2st_chase_distributed(band, nb, grid,
+                                                   want_vectors=False)
+            e = jnp.abs(e_c)
+        else:
+            d, e = hb2st(band, kd=nb, want_vectors=False,
+                         pipeline=chase_pipeline)
         # values-only always takes sterf — D&C inherently carries vectors
         # (merge z-couplings ARE eigenvector rows), exactly why the reference
         # routes no-vector solves to sterf too (heev.cc:208-215)
@@ -348,8 +365,12 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     # shards over mesh rows with zero collectives (round-5; was replicated)
     from ..linalg.eig import hb2st_reflectors
 
-    d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
-                                        pipeline=chase_pipeline)
+    if use_dist_chase:
+        d, e_c, Vcs, tcs = hb2st_chase_distributed(band, nb, grid,
+                                                   want_vectors=True)
+    else:
+        d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
+                                            pipeline=chase_pipeline)
     e = jnp.abs(e_c)
     Q2 = hb2st_q_distributed(Vcs, tcs, e_c, band.shape[-1], grid)
     if method_eig == "bisection":
