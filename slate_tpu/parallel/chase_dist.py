@@ -33,6 +33,14 @@ single extra left column.
 Results match the single-device pipelined chase bit-for-bit in the same
 XLA configuration: same windows, same reflectors, same order per front
 (pinned by tests/test_chase_dist.py against _hb2st_chase_pipelined).
+
+The two kernels here (hb2st and tb2bd) share the segmentation idea but are
+kept as separate builders on purpose: they differ in left margin (1 vs
+b+1), exchange-square anchor (boundary-1 vs boundary-b-1), mirror writes
+(Hermitian only), carried reflector family (v vs u), and per-window math —
+a parameterized common scaffold was tried and read worse than the ~80
+shared lines it saved.  Both are pinned output-for-output against their
+single-device schedules, which is what keeps the pair honest.
 """
 
 from __future__ import annotations
@@ -220,6 +228,219 @@ def _chase_dist_fn(mesh, n: int, b: int, seg: int, want_vectors: bool,
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(P(AX, None),),
                        out_specs=out_specs, check_vma=False)
     return jax.jit(fn)
+
+
+@lru_cache(maxsize=16)
+def _tb2bd_dist_fn(mesh, n: int, b: int, seg: int, want_vectors: bool,
+                   dtype_str: str):
+    """shard_map bidiagonal chase (tb2bd) for static (mesh, n, b, seg).
+
+    Same segmentation as the Hermitian kernel with three differences that
+    follow from the upper-band geometry (svd.py:_tb2bd_chase_pipelined):
+    - the gebr1 window at (s, s+1) reaches b+1 columns left of its sweep's
+      r=1 anchor j = s+b+1, so tiles carry a b+1 left margin (vs 1);
+    - no mirror writes (the band is not Hermitian), and the exchange square
+      sits at [boundary-b-1, boundary+b): gebr2 rows dip b below the
+      anchor, gebr1 a further 1;
+    - TWO reflector families: v (right) is generated fresh per step, u
+      (left) is the carried one — the crossing payload ships (u, tauu, s).
+    """
+    from ..linalg import householder as hh
+
+    P_ = mesh.shape[ROW_AXIS] * mesh.shape[COL_AXIS]
+    dt = jnp.dtype(dtype_str)
+    n_sweeps = max(n - 1, 0)
+    m_max = max(-(-(n - 1) // b), 1)
+    T = 2 * n_sweeps + m_max
+    B_loc = seg // (2 * b - 1) + 1
+    S_cap = B_loc + 2
+    lm = b + 1                               # left margin (gebr1 reach)
+    M = seg + 2 * b + lm + 2 * b + 3         # real+halo + zero-land
+    lz = seg + 2 * b + lm + 1                # zero-land i-anchor (local)
+    W_pad = P_ * seg + M                     # strip width (cols never sharded)
+    sq = 2 * b + 1                           # exchange-square edge
+    ar_b = jnp.arange(b)
+
+    def local_fn(strip):                     # (seg, W_pad): rows [c0, c0+seg)
+        p = lax.axis_index(AX)
+        c0 = p * seg
+        c1 = c0 + seg
+        g0 = jnp.maximum(c0 - lm, 0)         # tile origin (global)
+        prev_tail = _shift_right(strip[-lm:], P_)
+        next_head = _shift_left(strip[: 2 * b], P_)
+        zpad = jnp.zeros((M + lm - (lm + seg + 2 * b), W_pad), dt)
+        rows_ext = jnp.concatenate([prev_tail, strip, next_head, zpad], 0)
+        off = g0 - (c0 - lm)                 # lm on device 0, else 0
+        tile = lax.dynamic_slice(rows_ext, (off, jnp.zeros_like(off)),
+                                 (M, W_pad))
+        tile = lax.dynamic_slice(tile, (jnp.zeros_like(g0), g0), (M, M))
+        re = c1 + 2 * b - g0
+        arM = jnp.arange(M)
+        keep = (arM < re)[:, None] & (arM < re)[None, :]
+        tile = jnp.where(keep, tile, jnp.zeros((), dt))
+        lL = jnp.maximum(c0 - b - 1, 0) - g0  # left exchange square (local)
+        lR = c1 - b - 1 - g0                  # right exchange square (local)
+
+        stu0 = jnp.zeros((S_cap, b), dt)
+        stt0 = jnp.zeros((S_cap,), dt)
+        nvs = n_sweeps + 1 if want_vectors else 1
+        Us0 = jnp.zeros((nvs, m_max, b), dt)
+        tauus0 = jnp.zeros((nvs, m_max), dt)
+        Vs0 = jnp.zeros((nvs, m_max, b), dt)
+        tauvs0 = jnp.zeros((nvs, m_max), dt)
+
+        def round_body(t, carry):
+            tile, stu, stt, Us, tauus, Vs, tauvs = carry
+            snapL = lax.dynamic_slice(tile, (lL, lL), (sq, sq))
+            snapR = lax.dynamic_slice(tile, (lR, lR), (sq, sq))
+
+            # ---- gebr1: owned by the device of its r=1 anchor s0+b+1 -----
+            s0 = t // 2
+            start = (2 * s0 == t) & (s0 < n_sweeps)
+            # ownership anchor: the r=1 front's column for the same-round u0
+            # handoff; tail sweeps (s0+b+1 >= n) have no r=1 front, so their
+            # anchor clamps to the last real column (the last device's tile
+            # still contains the whole (s0, s0+1) window)
+            jown = jnp.minimum(s0 + b + 1, n - 1)
+            own1 = start & (jown >= c0) & (jown < c1)
+            a1 = jnp.where(own1, s0 - g0, lz)
+            W = lax.dynamic_slice(tile, (a1, a1 + 1), (b + 1, b))
+            v0, tauv0, _ = hh.larfg(jnp.conj(W[0, :]))
+            W = hh.apply_right(tauv0, v0, W)
+            u0, tauu0, _ = hh.larfg(W[1:, 0])
+            W = W.at[1:, :].set(hh.apply_left(tauu0, u0, W[1:, :]))
+            tile = lax.dynamic_update_slice(tile, W, (a1, a1 + 1))
+            k0 = jnp.where(own1, s0 % S_cap, S_cap)
+            stu = stu.at[k0].set(u0, mode="drop")
+            stt = stt.at[k0].set(tauu0, mode="drop")
+            if want_vectors:
+                sv = jnp.where(own1, s0, n_sweeps)
+                Vs = Vs.at[sv, 0].set(jnp.where(own1, v0, Vs[sv, 0]))
+                tauvs = tauvs.at[sv, 0].set(
+                    jnp.where(own1, tauv0, tauvs[sv, 0]))
+                Us = Us.at[sv, 0].set(jnp.where(own1, u0, Us[sv, 0]))
+                tauus = tauus.at[sv, 0].set(
+                    jnp.where(own1, tauu0, tauus[sv, 0]))
+
+            # ---- batched gebr2+gebr3 over my live fronts -----------------
+            # front (s, r=t-2s+1) at diagonal anchor j = (t+1)b+1 - s(2b-1)
+            s_start = -((c1 - (t + 1) * b - 2) // (2 * b - 1))
+            s_q = s_start + jnp.arange(B_loc)
+            j_q = (t + 1) * b + 1 - s_q * (2 * b - 1)
+            r_q = t - 2 * s_q + 1
+            active = ((s_q >= 0) & (s_q < n_sweeps) & (r_q >= 1)
+                      & (j_q < n) & (j_q >= c0) & (j_q < c1))
+            li = jnp.where(active, j_q - b - g0, lz)       # gebr2 row anchor
+            ljj = jnp.where(active, j_q - g0, lz + b)      # col/diag anchor
+            up = stu[s_q % S_cap]
+            tp = stt[s_q % S_cap]
+            rows_i = li[:, None] + ar_b[None, :]
+            cols_j = ljj[:, None] + ar_b[None, :]
+            # gebr2: left-apply previous u, then new right v zeroing row 0
+            Wb = tile[rows_i[:, :, None], cols_j[:, None, :]]
+            uW = jnp.einsum("bi,bij->bj", jnp.conj(up), Wb)
+            Wb = Wb - jnp.conj(tp)[:, None, None] * up[:, :, None] * uW[:, None, :]
+            v, tauv, _ = hh.larfg(jnp.conj(Wb[:, 0, :]))
+            Wv = jnp.einsum("bij,bj->bi", Wb, v)
+            Wb = Wb - tauv[:, None, None] * Wv[:, :, None] * jnp.conj(v)[:, None, :]
+            tile = tile.at[rows_i[:, :, None], cols_j[:, None, :]].set(Wb)
+            # gebr3: right-apply v on the diagonal window, new left u
+            Db = tile[cols_j[:, :, None], cols_j[:, None, :]]
+            Dv = jnp.einsum("bij,bj->bi", Db, v)
+            Db = Db - tauv[:, None, None] * Dv[:, :, None] * jnp.conj(v)[:, None, :]
+            u, tauu, _ = hh.larfg(Db[:, :, 0])
+            uD = jnp.einsum("bi,bij->bj", jnp.conj(u), Db)
+            Db = Db - jnp.conj(tauu)[:, None, None] * u[:, :, None] * uD[:, None, :]
+            tile = tile.at[cols_j[:, :, None], cols_j[:, None, :]].set(Db)
+            kq = jnp.where(active, s_q % S_cap, S_cap)
+            stu = stu.at[kq].set(u, mode="drop")
+            stt = stt.at[kq].set(tauu, mode="drop")
+            if want_vectors:
+                s_c = jnp.where(active, s_q, n_sweeps)
+                r_c = jnp.where(active, r_q, 0)
+                Vs = Vs.at[s_c, r_c].set(
+                    jnp.where(active[:, None], v, Vs[s_c, r_c]))
+                tauvs = tauvs.at[s_c, r_c].set(
+                    jnp.where(active, tauv, tauvs[s_c, r_c]))
+                Us = Us.at[s_c, r_c].set(
+                    jnp.where(active[:, None], u, Us[s_c, r_c]))
+                tauus = tauus.at[s_c, r_c].set(
+                    jnp.where(active, tauu, tauus[s_c, r_c]))
+
+            # ---- neighbor reconciliation ---------------------------------
+            dL = lax.dynamic_slice(tile, (lL, lL), (sq, sq)) - snapL
+            dR = lax.dynamic_slice(tile, (lR, lR), (sq, sq)) - snapR
+            crossing = active & (j_q >= c1 - b)
+            cvalid = jnp.any(crossing).astype(jnp.int32)
+            cs = jnp.sum(jnp.where(crossing, s_q, 0))
+            cu = jnp.sum(jnp.where(crossing[:, None], u, 0), axis=0)
+            ct = jnp.sum(jnp.where(crossing, tauu, 0))
+            rdelta = _shift_right(dR, P_)
+            ru = _shift_right(cu, P_)
+            rt = _shift_right(ct, P_)
+            rs = _shift_right(cs, P_)
+            rvalid = _shift_right(cvalid, P_)
+            ldelta = _shift_left(dL, P_)
+            tile = lax.dynamic_update_slice(
+                tile, lax.dynamic_slice(tile, (lL, lL), (sq, sq)) + rdelta,
+                (lL, lL))
+            tile = lax.dynamic_update_slice(
+                tile, lax.dynamic_slice(tile, (lR, lR), (sq, sq)) + ldelta,
+                (lR, lR))
+            kin = jnp.where(rvalid == 1, rs % S_cap, S_cap)
+            stu = stu.at[kin].set(ru, mode="drop")
+            stt = stt.at[kin].set(rt, mode="drop")
+            return tile, stu, stt, Us, tauus, Vs, tauvs
+
+        tile, stu, stt, Us, tauus, Vs, tauvs = lax.fori_loop(
+            0, T, round_body,
+            (tile, stu0, stt0, Us0, tauus0, Vs0, tauvs0))
+
+        lx = jnp.arange(seg) + (c0 - g0)
+        d_loc = tile[lx, lx]
+        e_loc = tile[lx, lx + 1]             # e[x] = B[x, x+1]
+        if want_vectors:
+            Us = lax.psum(Us, AX)
+            tauus = lax.psum(tauus, AX)
+            Vs = lax.psum(Vs, AX)
+            tauvs = lax.psum(tauvs, AX)
+        return d_loc, e_loc, Us, tauus, Vs, tauvs
+
+    out_specs = (P(AX), P(AX), P(None), P(None), P(None), P(None))
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(P(AX, None),),
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)
+
+
+def tb2bd_chase_distributed(Bfull: jax.Array, kd: int, grid: ProcessGrid,
+                            want_vectors: bool = False):
+    """Segment-parallel bidiagonal chase (the SVD stage 2) over ``grid``.
+
+    ``Bfull``: square upper band (bandwidth ``kd``), dense storage.  Returns
+    ``(d_c, e_c, Us, tauus, Vs, tauvs)`` matching
+    ``linalg.svd._tb2bd_chase_pipelined`` (reflector stacks are zeros when
+    ``want_vectors=False``).
+    """
+    n = Bfull.shape[-1]
+    b = int(kd)
+    P_ = grid.size
+    slate_assert(b >= 2 and n > 1, "tb2bd chase needs kd >= 2 and n > 1")
+    seg = -(-n // P_)
+    slate_assert(seg >= 2 * b + 2,
+                 f"segment {seg} too narrow for bandwidth {b} on {P_} devices"
+                 " (need n/P >= 2*kd+2); use the replicated chase")
+    M = seg + 2 * b + (b + 1) + 2 * b + 3
+    W_pad = P_ * seg + M
+    Bp = jnp.zeros((P_ * seg, W_pad), Bfull.dtype)
+    Bp = Bp.at[:n, :n].set(Bfull)
+    fn = _tb2bd_dist_fn(grid.mesh, n, b, seg, bool(want_vectors),
+                        str(Bfull.dtype))
+    d_all, e_all, Us, tauus, Vs, tauvs = fn(Bp)
+    d_c = d_all[:n]
+    e_c = e_all[: n - 1]
+    n_sweeps = max(n - 1, 0)
+    return (d_c, e_c, Us[:n_sweeps], tauus[:n_sweeps],
+            Vs[:n_sweeps], tauvs[:n_sweeps])
 
 
 def hb2st_chase_distributed(Afull: jax.Array, kd: int, grid: ProcessGrid,

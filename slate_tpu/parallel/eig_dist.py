@@ -521,13 +521,17 @@ def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
 
 def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                     want_vectors: bool = True, chase_pipeline: bool = False,
-                    method_svd: str = "auto"):
+                    method_svd: str = "auto",
+                    chase_distributed: bool = False):
     """Distributed SVD over the (p, q) mesh (src/svd.cc pipeline).
 
     Returns (S descending, U or None, VT or None); U/VT come back sharded.
     Wide inputs run on the conjugate transpose (U/VT swap), like the
     reference's LQ pre-step (svd.cc:224+).  ``method_svd='bisection'``
     solves the bidiagonal stage by GK bisection (+ stein vectors).
+    ``chase_distributed=True`` runs the tb2bd stage segment-parallel over
+    the mesh (parallel/chase_dist.py) instead of replicating it; requires
+    n/P >= 2*nb+2 (falls back to the replicated chase below that floor).
     """
     from ..linalg.eig import _safe_scale
     from ..linalg.svd import _bidiag_phases, bdsqr, tb2bd
@@ -543,7 +547,9 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     if m < n:
         S, V, UT = svd_distributed(jnp.conj(A).T, grid, nb=nb,
                                    want_vectors=want_vectors,
-                                   chase_pipeline=chase_pipeline)
+                                   chase_pipeline=chase_pipeline,
+                                   method_svd=method_svd,
+                                   chase_distributed=chase_distributed)
         if not want_vectors:
             return S, None, None
         return S, jnp.conj(UT).T, jnp.conj(V).T
@@ -563,14 +569,17 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
             S, _, _ = svd_distributed(R[:n, :n], grid, nb=nb,
                                       want_vectors=False,
                                       chase_pipeline=chase_pipeline,
-                                      method_svd=method_svd)
+                                      method_svd=method_svd,
+                                      chase_distributed=chase_distributed)
             return S, None, None
         from .qr_dist import geqrf_distributed
 
         Q, R = geqrf_distributed(A, grid, nb=max(nb, 32))
         S, UR, VT = svd_distributed(R[:n, :n], grid, nb=nb,
                                     want_vectors=True,
-                                    chase_pipeline=chase_pipeline)
+                                    chase_pipeline=chase_pipeline,
+                                    method_svd=method_svd,
+                                    chase_distributed=chase_distributed)
         U = jnp.matmul(Q[:, :n], UR, precision=lax.Precision.HIGHEST)
         return S, _shard(U, grid), VT
     k = n
@@ -584,6 +593,10 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     band, Uf, Vf = ge2tb_distributed(a, grid, nb=nb)
     band = jax.device_put(band, grid.replicated())
     sq = band[:k, :k]
+    use_dist_chase = (chase_distributed and nb >= 2 and k > 2
+                      and -(-k // (grid.p * grid.q)) >= 2 * nb + 2)
+    if use_dist_chase:
+        from .chase_dist import tb2bd_chase_distributed
     if k > 2 and want_vectors:
         # reflector-level chase (replicated, the cheap part) + BOTH vector
         # accumulations sharded over mesh rows with zero collectives
@@ -591,17 +604,26 @@ def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         from ..linalg.svd import _bidiag_phases as _phases
         from ..linalg.svd import tb2bd_reflectors
 
-        d_c, e_c, Us, tauus, Vcs, tauvs = tb2bd_reflectors(
-            sq, nb, pipeline=chase_pipeline)
+        if use_dist_chase:
+            d_c, e_c, Us, tauus, Vcs, tauvs = tb2bd_chase_distributed(
+                sq, nb, grid, want_vectors=True)
+        else:
+            d_c, e_c, Us, tauus, Vcs, tauvs = tb2bd_reflectors(
+                sq, nb, pipeline=chase_pipeline)
         pu, pw = _phases(d_c, e_c, a.dtype)
         d, e = jnp.abs(d_c), jnp.abs(e_c)
         U2 = _sweep_q_distributed(Us, tauus, pu, k, grid)
         V2 = _sweep_q_distributed(Vcs, tauvs, pw, k, grid)
         VT2 = jnp.conj(V2).T
     elif k > 2:
-        out = tb2bd(sq, nb, want_vectors=False,
-                    pipeline=chase_pipeline)
-        d, e = out[0], out[1]
+        if use_dist_chase:
+            d_c, e_c, _, _, _, _ = tb2bd_chase_distributed(
+                sq, nb, grid, want_vectors=False)
+            d, e = jnp.abs(d_c), jnp.abs(e_c)
+        else:
+            out = tb2bd(sq, nb, want_vectors=False,
+                        pipeline=chase_pipeline)
+            d, e = out[0], out[1]
         U2, VT2 = None, None
     else:
         d_c = jnp.diagonal(sq)
