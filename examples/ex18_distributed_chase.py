@@ -1,0 +1,74 @@
+"""ex18: round-5 distributed stage 2 — the segment-parallel bulge chases
+(hb2st for eig, tb2bd for SVD) and the two-stage drivers that consume them.
+
+The reference confines the chase to rank 0 (src/heev.cc:137-160 gathers the
+band there; src/hb2st.cc schedules threads on one process).  Here the band's
+column range partitions across the mesh and neighbors reconcile with O(kd²)
+ppermute deltas per round — per-device window work divided by P
+(parallel/chase_dist.py; compiled-cost table in PERF_CPU.md).
+
+Run on the virtual mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/ex18_distributed_chase.py
+"""
+
+import numpy as np
+
+from slate_tpu.parallel import (
+    ProcessGrid, heev_distributed, hb2st_chase_distributed, svd_distributed,
+    tb2bd_chase_distributed)
+
+
+def main():
+    import jax.numpy as jnp
+
+    grid = ProcessGrid(2, 4)
+    rng = np.random.default_rng(18)
+    n, kd = 192, 6
+
+    # --- the chase kernels directly, on synthetic bands ------------------
+    m = rng.standard_normal((n, n)).astype(np.float32)
+    sym = (m + m.T) / 2
+    ii = np.arange(n)
+    hband = jnp.asarray(np.where(np.abs(ii[:, None] - ii[None, :]) <= kd,
+                                 sym, 0))
+    d, e_c, _, _ = hb2st_chase_distributed(hband, kd, grid)
+    T = (np.diag(np.asarray(d))
+         + np.diag(np.abs(np.asarray(e_c)), -1)
+         + np.diag(np.abs(np.asarray(e_c)), 1))
+    err = np.max(np.abs(np.linalg.eigvalsh(T)
+                        - np.linalg.eigvalsh(np.asarray(hband))))
+    print("hb2st_chase_distributed spectrum err:", err)
+    assert err < 1e-3
+
+    uband = jnp.asarray(np.where((ii[None, :] >= ii[:, None])
+                                 & (ii[None, :] - ii[:, None] <= kd), m, 0))
+    db, eb, *_ = tb2bd_chase_distributed(uband, kd, grid)
+    Bd = np.diag(np.abs(np.asarray(db))).astype(np.float64)
+    Bd[np.arange(n - 1), np.arange(1, n)] = np.abs(np.asarray(eb))
+    sv_err = np.max(np.abs(np.linalg.svd(Bd, compute_uv=False)
+                           - np.linalg.svd(np.asarray(uband),
+                                           compute_uv=False)))
+    print("tb2bd_chase_distributed singular-value err:", sv_err)
+    assert sv_err < 1e-3
+
+    # --- end to end: two-stage drivers with the sharded stage 2 ----------
+    lam, Z = heev_distributed(jnp.asarray(sym), ProcessGrid(2, 2), nb=8,
+                              want_vectors=True, chase_distributed=True)
+    resid = np.linalg.norm(sym @ np.asarray(Z)
+                           - np.asarray(Z) * np.asarray(lam)[None, :]) \
+        / (np.linalg.norm(sym) * n)
+    print("heev_distributed(chase_distributed) resid:", resid)
+    assert resid < 1e-6
+
+    S, U, VT = svd_distributed(jnp.asarray(m), ProcessGrid(2, 2), nb=8,
+                               want_vectors=True, chase_distributed=True)
+    rec = np.asarray(U) * np.asarray(S)[None, :] @ np.asarray(VT)
+    rec_err = np.linalg.norm(rec - m) / np.linalg.norm(m)
+    print("svd_distributed(chase_distributed) reconstruction:", rec_err)
+    assert rec_err < 1e-4
+    print("ex18 OK")
+
+
+if __name__ == "__main__":
+    main()
