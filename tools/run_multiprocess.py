@@ -199,6 +199,31 @@ def worker(coord: str, pid: int) -> None:
             atol=1e-2)
     print(f"worker {pid}: rbt OK", flush=True)
 
+    # --- 8) round-5: segment-parallel bulge chase — its per-round boundary
+    # deltas and crossing-reflector ppermutes ride the flattened mesh axis,
+    # so between devices 3 and 4 they cross the PROCESS boundary every round
+    from slate_tpu.parallel import hb2st_chase_distributed
+    from slate_tpu.linalg.eig import _hb2st_chase_pipelined
+
+    nc, bc = 48, 2
+    Mc = rng.standard_normal((nc, nc)).astype(np.float32)
+    symc = (Mc + Mc.T) / 2
+    iic = np.arange(nc)
+    bandc = jnp.asarray(np.where(np.abs(iic[:, None] - iic[None, :]) <= bc,
+                                 symc, 0))
+    d_ref, e_ref, _, _ = _hb2st_chase_pipelined(bandc, bc)   # local replay
+    dd, ee, _, _ = hb2st_chase_distributed(bandc, bc, grid)
+    d_ref_np, e_ref_np = np.asarray(d_ref), np.asarray(e_ref)
+    for shard in dd.addressable_shards:
+        (sl,) = shard.index
+        np.testing.assert_allclose(np.asarray(shard.data), d_ref_np[sl],
+                                   atol=1e-4)
+    for shard in ee.addressable_shards:
+        (sl,) = shard.index
+        np.testing.assert_allclose(np.asarray(shard.data), e_ref_np[sl],
+                                   atol=1e-4)
+    print(f"worker {pid}: chase OK", flush=True)
+
     jax.distributed.shutdown()
     print(f"worker {pid}: OK", flush=True)
 
