@@ -26,6 +26,7 @@ TPU re-design:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -219,8 +220,6 @@ def he2hb(A, opts=None, uplo=None, nb: Optional[int] = None):
     ``Q = prod_j (I - Vs[j] Ts[j] Vs[j]^H)``; band has bandwidth nb (both
     triangles kept — the dense Hermitian band).
     """
-    from . import householder as hh
-
     opts = Options.make(opts)
     a = _full_herm(A, uplo)
     n = a.shape[-1]
@@ -235,6 +234,20 @@ def he2hb(A, opts=None, uplo=None, nb: Optional[int] = None):
     nj = max(nt - 1, 0)
     if nj == 0:
         return a, jnp.zeros((0, n, nb), a.dtype), jnp.zeros((0, nb, nb), a.dtype)
+    return _he2hb_core(a, nb)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _he2hb_core(a, nb: int):
+    """Jitted he2hb body.  Module-level jit is load-bearing, not style: the
+    panel QR traces O(nb) masked-larfg ops per call, and running the
+    fori_loop eagerly re-traced all of it on EVERY call — 56 s of host work
+    for a 1.2 s computation at n=1024 (measured round 5; the 'two-stage is
+    slow' CPU numbers were mostly this)."""
+    from . import householder as hh
+
+    n = a.shape[-1]
+    nj = max(-(-n // nb) - 1, 0)
 
     def body(j, carry):
         Acur, Vs, Ts = carry
@@ -549,9 +562,12 @@ def _hb2st_q(Vs: jax.Array, taus: jax.Array, n: int, b: int) -> jax.Array:
     return sweep_accumulate(Vs, taus, n, b)
 
 
+@partial(jax.jit, static_argnums=(1, 2))
 def _hb2st_run_chase(b_arr: jax.Array, kd: int, pipeline: bool):
     """Normalize band storage to the full dense Hermitian form and run the
-    bulge chase; returns (d, e_c, Vs, taus) — the reflector-level output."""
+    bulge chase; returns (d, e_c, Vs, taus) — the reflector-level output.
+    Jitted at module level: the chase traces thousands of window ops and an
+    eager call re-traced them every time (see _he2hb_core)."""
     n = b_arr.shape[-1]
     idx = jnp.arange(n)
     lower = jnp.tril(b_arr, -1)

@@ -23,6 +23,7 @@ TPU re-design notes:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import jax
@@ -168,6 +169,7 @@ def build_T(V: jax.Array, taus: jax.Array, off=None) -> jax.Array:
 _SWEEP_GROUP = 8
 
 
+@partial(jax.jit, static_argnums=(2, 3, 4))
 def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
                      group: int = _SWEEP_GROUP, Q0=None) -> jax.Array:
     """Accumulate Q = prod_s prod_r H_{s,r} (chronological) from bulge-chase

@@ -38,6 +38,7 @@ level only when MethodEig::QR is requested).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -169,6 +170,8 @@ def _wilkinson(d, e, m):
     return d[m] - em * em / jnp.where(jnp.abs(denom) > 0, denom, 1.0)
 
 
+@partial(jax.jit,
+         static_argnames=("want_vectors", "max_sweeps", "return_info"))
 def steqr_qr(d, e, Z: Optional[jax.Array] = None, *,
              want_vectors: bool = True, max_sweeps: Optional[int] = None,
              return_info: bool = False):

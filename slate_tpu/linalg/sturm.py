@@ -17,6 +17,8 @@ n=20,000 BASELINE config.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -50,6 +52,7 @@ def _sturm_counts(d: jax.Array, e2: jax.Array, x: jax.Array) -> jax.Array:
     return cnt
 
 
+@partial(jax.jit, static_argnames=("iters",))
 def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None):
     """All eigenvalues (ascending) of the symmetric tridiagonal T(d, e) by
     index-targeted bisection — every eigenvalue's bracket halves in the same
@@ -93,6 +96,7 @@ def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None):
     return 0.5 * (lo + hi) * s
 
 
+@partial(jax.jit, static_argnames=("iters",))
 def stein(d: jax.Array, e: jax.Array, lam: jax.Array,
           iters: int = 3) -> jax.Array:
     """Eigenvectors of the symmetric tridiagonal T(d, e) for precomputed

@@ -13,6 +13,7 @@ stages are provided for parity and future distributed composition.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -207,7 +208,6 @@ def ge2tb_band(A, opts=None, nb: Optional[int] = None):
     Returns ``(band, (Vu, Tu), (Vv, Tv))`` with ``A = U band V^H``,
     ``U = prod_j (I - Vu[j] Tu[j] Vu[j]^H)``, ``V = prod_j (I - Vv[j] Tv[j] Vv[j]^H)``.
     """
-    from . import householder as hh
     from .eig import default_band_nb
 
     opts = Options.make(opts)
@@ -218,6 +218,18 @@ def ge2tb_band(A, opts=None, nb: Optional[int] = None):
     k = n
     if nb is None:
         nb = default_band_nb(k, opts)
+    return _ge2tb_band_core(a, nb)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _ge2tb_band_core(a, nb: int):
+    """Jitted ge2tb_band body (module-level jit is load-bearing: the panel
+    QR/LQ pair traces O(nb) masked-larfg ops and an eager fori_loop re-traced
+    them on every call — see eig._he2hb_core)."""
+    from . import householder as hh
+
+    m, n = a.shape[-2:]
+    k = n
     nt = max(-(-k // nb), 1)
     # pad so the last panel's slice never clamps (dynamic_slice clamps
     # out-of-bounds starts, which would silently grab shifted columns)
@@ -483,6 +495,13 @@ def tb2bd_reflectors(band, kd, pipeline: bool = False):
     slate_assert(kd > 1, "tb2bd_reflectors needs kd > 1 (no chase below)")
     kb = min(b.shape[-2:])
     sq = b[..., :kb, :kb]
+    return _tb2bd_run_chase(sq, kd, pipeline)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _tb2bd_run_chase(sq, kd: int, pipeline: bool):
+    """Jitted chase dispatch (module-level jit is load-bearing — see
+    eig._he2hb_core)."""
     chase = _tb2bd_chase_pipelined if pipeline else _tb2bd_chase
     return chase(sq, kd)
 
