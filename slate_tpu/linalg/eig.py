@@ -62,7 +62,8 @@ def _safe_scale(a):
 
 
 def heev(A, opts=None, uplo=None, want_vectors: bool = True,
-         method: str = "fused", chase_pipeline: bool = False):
+         method: str = "fused", chase_pipeline: bool = False,
+         chase_distributed: bool = False):
     """Hermitian eigensolve (src/heev.cc). Returns (Lambda ascending, Z or None).
 
     method:
@@ -91,8 +92,13 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
             method_eig={MethodEig.QR: "qr",
                         MethodEig.Bisection: "bisection"}.get(
                             opts.method_eig, "dc"),
-            chase_pipeline=chase_pipeline)
+            chase_pipeline=chase_pipeline,
+            chase_distributed=chase_distributed)
         return (lam, z) if want_vectors else (lam, None)
+    slate_assert(not chase_distributed,
+                 "chase_distributed requires a grid-bound wrapper "
+                 "(Matrix.from_array(..., grid=...)); the single-device "
+                 "two-stage path has nothing to distribute")
     if method == "two_stage" and n < 8:
         method = "fused"  # no meaningful band structure below one panel
     with trace_block("heev", n=n):

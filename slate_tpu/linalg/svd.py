@@ -29,7 +29,8 @@ from .qr import geqrf, unmqr
 
 
 def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
-        method: str = "fused", chase_pipeline: bool = False):
+        method: str = "fused", chase_pipeline: bool = False,
+        chase_distributed: bool = False):
     """Singular value decomposition A = U S V^H (src/svd.cc).
 
     Returns (S descending, U or None, VT or None).  Tall/wide matrices take the QR/LQ
@@ -61,8 +62,13 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
         S, U, VT = svd_distributed(a, grid, nb=default_band_nb(min(m, n), opts),
                                    want_vectors=want_vectors,
                                    chase_pipeline=chase_pipeline,
-                                   method_svd=str(opts.method_svd))
+                                   method_svd=str(opts.method_svd),
+                                   chase_distributed=chase_distributed)
         return S, (U if want_u else None), (VT if want_vt else None)
+    slate_assert(not chase_distributed,
+                 "chase_distributed requires a grid-bound wrapper "
+                 "(Matrix.from_array(..., grid=...)); the single-device "
+                 "two-stage path has nothing to distribute")
     if method == "two_stage":
         with trace_block("svd_two_stage", m=m, n=n):
             with timers.time("svd::scale"):

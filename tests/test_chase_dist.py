@@ -158,6 +158,61 @@ def test_svd_distributed_chase_distributed(rng):
         np.asarray(A)) < 1e-10
 
 
+def test_public_driver_chase_distributed_kwarg(rng):
+    """The public heev/svd drivers forward chase_distributed to the
+    distributed pipeline when the wrapper is grid-bound."""
+    import slate_tpu as slate
+
+    n = 96
+    m = rng.standard_normal((n, n))
+    A = (m + m.T) / 2
+    grid = ProcessGrid(2, 2)
+    Aw = slate.Matrix.from_array(jnp.asarray(A.copy()), nb=8, grid=grid)
+    lam, _ = slate.heev(Aw, {"block_size": 8}, want_vectors=False,
+                        chase_distributed=True)
+    ref = np.linalg.eigvalsh(A)
+    assert np.max(np.abs(np.sort(np.asarray(lam)) - ref)) < 1e-8 * n
+
+    G = rng.standard_normal((n, n))
+    Gw = slate.Matrix.from_array(jnp.asarray(G.copy()), nb=8, grid=grid)
+    S, _, _ = slate.svd(Gw, {"block_size": 8}, want_u=False, want_vt=False,
+                        chase_distributed=True)
+    sv_ref = np.linalg.svd(G, compute_uv=False)
+    assert np.max(np.abs(np.sort(np.asarray(S)) - np.sort(sv_ref))) < 1e-8
+
+
+def test_public_driver_chase_distributed_forwarding(rng, monkeypatch):
+    """Pin the actual forwarding (numerics cannot distinguish the chases):
+    the distributed pipeline must RECEIVE chase_distributed=True, and a
+    gridless call must refuse rather than silently ignore the flag."""
+    import slate_tpu as slate
+    from slate_tpu import parallel as par
+    from slate_tpu.core.exceptions import SlateError
+
+    n = 96
+    m = rng.standard_normal((n, n))
+    A = (m + m.T) / 2
+    grid = ProcessGrid(2, 2)
+    seen = {}
+    real = par.heev_distributed
+
+    def spy(a, g, **kw):
+        seen.update(kw)
+        return real(a, g, **kw)
+
+    monkeypatch.setattr(par, "heev_distributed", spy)
+    Aw = slate.Matrix.from_array(jnp.asarray(A.copy()), nb=8, grid=grid)
+    slate.heev(Aw, {"block_size": 8}, want_vectors=False,
+               chase_distributed=True)
+    assert seen.get("chase_distributed") is True
+
+    with pytest.raises(SlateError):
+        slate.heev(jnp.asarray(A), want_vectors=False, chase_distributed=True)
+    with pytest.raises(SlateError):
+        slate.svd(jnp.asarray(m), want_u=False, want_vt=False,
+                  chase_distributed=True)
+
+
 def test_chase_distributed_perdevice_work_shrinks():
     """Compiled-module sharding evidence (the PERF_CPU.md methodology): the
     per-device round body's flops and touched bytes shrink superlinearly
