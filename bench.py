@@ -592,7 +592,7 @@ def child_heev2s(cpu_fallback):
     import jax
     import jax.numpy as jnp
 
-    n = 512 if cpu_fallback else 8192
+    n = 512 if cpu_fallback else int(os.environ.get("BENCH_HEEV2S_N", 8192))
     key = jax.random.PRNGKey(0)
     m = jax.random.normal(key, (n, n), dtype=jnp.float32)
     a = (m + m.T) / 2.0
@@ -651,7 +651,7 @@ def child_svd2s(cpu_fallback):
     import jax
     import jax.numpy as jnp
 
-    n = 512 if cpu_fallback else 8192
+    n = 512 if cpu_fallback else int(os.environ.get("BENCH_SVD2S_N", 8192))
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), dtype=jnp.float32)
 
@@ -719,7 +719,7 @@ def _run_child(name, cpu_fallback, timeout):
     # config key (it would be scored against the default baseline and
     # backfilled as the kernel's last-known-good)
     for knob in ("BENCH_NORM_IMPL", "BENCH_POTRF_INVTRSM",
-                 "BENCH_GETRF_PANEL"):
+                 "BENCH_GETRF_PANEL", "BENCH_HEEV2S_N", "BENCH_SVD2S_N"):
         env.pop(knob, None)
     # soft deadline 120 s inside the hard timeout: the child finishes (or
     # truncates) and exits on its own instead of being SIGKILLed mid-RPC,

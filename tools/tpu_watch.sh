@@ -65,7 +65,10 @@ for pass in 1 2 3; do
   # (b) round-4 additions that have never touched the chip
   run_group g_la_f64_ir "potrf_la,f64gemm,gesvir" 2400 2600
   run_child s_potrf_la_nb1024 1000 potrf_la BENCH_POTRF_LA_NB=1024
-  # (c) two-stage pipelines at n=8192 with phase splits (VERDICT #4)
+  # (c) two-stage pipelines: a quick n=4096 capture first (lands evidence
+  #     in a short tunnel window), then the n=8192 configs with phase splits
+  run_child s_heev2s_n4096 1200 heev2s BENCH_HEEV2S_N=4096
+  run_child s_svd2s_n4096 1200 svd2s BENCH_SVD2S_N=4096
   run_group g_twostage "heev2s,svd2s" 4000 4300
   # (d) BASELINE-scale heev/svd (budget-truncating children land a number)
   run_group g_heev_svd "heev,svd" 3200 3400
@@ -84,9 +87,9 @@ for pass in 1 2 3; do
     timeout 1200 python tools/tpu_profile_potrf.py 2>&1 | tail -2
     mark_done s_profile
   fi
-  if [ "$(grep -c . "$STATE" 2>/dev/null || echo 0)" -ge 16 ]; then
-    log "all 16 steps complete"
+  if [ "$(grep -c . "$STATE" 2>/dev/null || echo 0)" -ge 18 ]; then
+    log "all 18 steps complete"
     exit 0
   fi
 done
-log "passes exhausted; $(grep -c . "$STATE" 2>/dev/null || echo 0)/16 steps done"
+log "passes exhausted; $(grep -c . "$STATE" 2>/dev/null || echo 0)/18 steps done"
