@@ -268,6 +268,22 @@ def _heev(dt, jobz, uplo, a, *, sy=False):
             else (np.asarray(lam), None))
 
 
+def _heevx(dt, jobz, uplo, a, il, iu, *, sy=False):
+    """LAPACK heevx/syevx range='I' (1-based INCLUSIVE il..iu, per LAPACK):
+    subset eigensolve via index-targeted bisection + inverse iteration —
+    a routine family the reference's lapack_api does not cover at all."""
+    (a,) = _as(dt, a)
+    from .linalg.eig import heev_range
+
+    uplo_e = Uplo.from_string(uplo)
+    M = (SymmetricMatrix if sy else HermitianMatrix).from_array(
+        uplo_e, a, nb=_nb(a.shape[0]))
+    lam, z = heev_range(M, _opts(), want_vectors=jobz.lower() == "v",
+                        il=int(il) - 1, iu=int(iu))
+    return ((np.asarray(lam), np.asarray(z)) if jobz.lower() == "v"
+            else (np.asarray(lam), None))
+
+
 def _hegv(dt, itype, jobz, uplo, a, b, *, sy=False):
     a, b = _as(dt, a, b)
     lam, z = _la.hegv(int(itype), a, b, _opts(), uplo=uplo,
@@ -374,6 +390,7 @@ _FAMILIES = {
     "gels": (_gels, {}),
     "heev": (_heev, {}), "heevd": (_heev, {}),
     "syev": (_heev, {"sy": True}), "syevd": (_heev, {"sy": True}),
+    "heevx": (_heevx, {}), "syevx": (_heevx, {"sy": True}),
     "hegv": (_hegv, {}), "sygv": (_hegv, {"sy": True}),
     "gesvd": (_gesvd, {}),
     "pbsv": (_pbsv, {}), "pbtrf": (_pbtrf, {}), "pbtrs": (_pbtrs, {}),
@@ -387,6 +404,7 @@ _SKIP = {
     ("s", "her2k"), ("d", "her2k"), ("s", "lanhe"), ("d", "lanhe"),
     ("s", "heev"), ("d", "heev"), ("s", "heevd"), ("d", "heevd"),
     ("c", "syev"), ("z", "syev"), ("c", "syevd"), ("z", "syevd"),
+    ("s", "heevx"), ("d", "heevx"), ("c", "syevx"), ("z", "syevx"),
     ("s", "hegv"), ("d", "hegv"), ("c", "sygv"), ("z", "sygv"),
     ("s", "hesv"), ("d", "hesv"),   # LAPACK: ssysv/dsysv but chesv/zhesv
     # LAPACK's csysv/zsysv solve complex *symmetric* (A == A.T) systems;

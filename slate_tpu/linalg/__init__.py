@@ -12,7 +12,8 @@ from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
 # re-binds that name to the driver *function* (the public contract)
 from .stedc import (stedc_deflate, stedc_merge, stedc_secular, stedc_solve,
                     stedc_sort, stedc_z_vector)
-from .eig import (hb2st, he2hb, he2hb_q, heev, hegst, hegv, stedc, steqr,
+from .eig import (eig_count, hb2st, he2hb, he2hb_q, heev, heev_range,
+                  hegst, hegv, stedc, steqr,
                   steqr2, sterf, syev, sygst, sygv, unmtr_hb2st, unmtr_he2hb)
 from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)

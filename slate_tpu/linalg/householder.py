@@ -169,9 +169,10 @@ def build_T(V: jax.Array, taus: jax.Array, off=None) -> jax.Array:
 _SWEEP_GROUP = 8
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(2, 3, 4, 6))
 def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
-                     group: int = _SWEEP_GROUP, Q0=None) -> jax.Array:
+                     group: int = _SWEEP_GROUP, Q0=None,
+                     reverse: bool = False) -> jax.Array:
     """Accumulate Q = prod_s prod_r H_{s,r} (chronological) from bulge-chase
     reflectors whose supports within sweep s are the adjacent length-b blocks
     starting at row/col ``s + 1 + r*b``.
@@ -191,6 +192,11 @@ def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
     hook the distributed layer uses to shard the accumulation over mesh
     rows with zero collectives (the reference's unmtr_hb2st 1-D row
     distribution, heev.cc:193-205).
+
+    ``reverse=True`` applies the CONJUGATE-TRANSPOSED product in reverse
+    chronological order — i.e. returns ``Q0 · Q^H`` — so ``Q · X`` for a
+    thin X is ``sweep_accumulate(..., Q0=X^H, reverse=True)^H`` without
+    materializing the (n, n) Q (the subset-eigenvector back-transform).
     """
     n_sweeps, m_max, _ = Vs.shape
     dt = Vs.dtype
@@ -202,6 +208,8 @@ def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
         Vs = jnp.concatenate(
             [Vs, jnp.zeros((pad_s, m_max, b), dt)], axis=0)
         taus = jnp.concatenate([taus, jnp.zeros((pad_s, m_max), dt)], axis=0)
+    if reverse:
+        taus = jnp.conj(taus)
     win = m_max * b + group - 1
     ncols = n + win + b + group
     m = n if Q0 is None else Q0.shape[-2]
@@ -209,9 +217,10 @@ def sweep_accumulate(Vs: jax.Array, taus: jax.Array, n: int, b: int,
         jnp.eye(n, dtype=dt) if Q0 is None else Q0.astype(dt))
 
     def body(g, Q):
-        s0 = g * group
+        s0 = (ng - 1 - g) * group if reverse else g * group
         W = lax.dynamic_slice(Q, (0, s0 + 1), (m, win))
-        for gi in range(group):           # in-register: one HBM round trip
+        order = range(group - 1, -1, -1) if reverse else range(group)
+        for gi in order:                  # in-register: one HBM round trip
             V = lax.dynamic_index_in_dim(Vs, s0 + gi, 0, keepdims=False)
             t = lax.dynamic_index_in_dim(taus, s0 + gi, 0, keepdims=False)
             S = lax.slice_in_dim(W, gi, gi + m_max * b, axis=1)

@@ -52,38 +52,53 @@ def _sturm_counts(d: jax.Array, e2: jax.Array, x: jax.Array) -> jax.Array:
     return cnt
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None):
-    """All eigenvalues (ascending) of the symmetric tridiagonal T(d, e) by
-    index-targeted bisection — every eigenvalue's bracket halves in the same
-    fused sweep.  O(n²·iters/n) lane-parallel work, O(n) memory."""
+def _prescale(d, e):
+    """Scale (d, e) by s so e*e cannot overflow/underflow (shared by the
+    bisection entry points; drivers' _safe_scale does not reach here)."""
+    dt = d.dtype
+    emax = jnp.max(jnp.abs(e)) if e.size else jnp.zeros((), dt)
+    s = jnp.maximum(jnp.maximum(jnp.max(jnp.abs(d)), emax),
+                    jnp.finfo(dt).tiny).astype(dt)
+    e2 = ((e / s) * (e / s)).astype(dt) if e.size else jnp.zeros((0,), dt)
+    return d / s, e / s, e2, s
+
+
+@partial(jax.jit, static_argnames=("iters", "il", "iu"))
+def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None,
+                 il: int = 0, iu: int | None = None):
+    """Eigenvalues (ascending) of the symmetric tridiagonal T(d, e) by
+    index-targeted bisection — every targeted eigenvalue's bracket halves in
+    the same fused sweep.  O(n·k·iters/n) lane-parallel work, O(k) memory.
+
+    ``il``/``iu`` select the half-open INDEX range [il, iu) of the ascending
+    spectrum (static, LAPACK stebz's range='I' — the subset feature the
+    bisection representation gives for free: the count predicate
+    ``cnt >= k+1`` targets any index vector).  Default: all n."""
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     dt = d.dtype
     n = d.shape[0]
     if n == 0:
         return d
+    if iu is None:
+        iu = n
+    if not (0 <= il < iu <= n):
+        raise ValueError(f"index range [{il}, {iu}) invalid for n={n}")
     if n == 1:
-        return d
+        return d[il:iu]
     if iters is None:
         # enough sweeps to shrink the Gershgorin span to ~4 ulp of ||T||
         iters = jnp.finfo(dt).nmant + 4
-    # pre-scale so e*e cannot overflow/underflow (the public entry points do
-    # not pass through the drivers' _safe_scale)
-    s = jnp.maximum(jnp.maximum(jnp.max(jnp.abs(d)), jnp.max(jnp.abs(e))),
-                    jnp.finfo(dt).tiny)
-    d = d / s
-    e = e / s
-    e2 = (e * e).astype(dt)
+    d, e, e2, s = _prescale(d, e)
     # Gershgorin bounds
     r = jnp.abs(jnp.concatenate([e, jnp.zeros((1,), dt)])) + jnp.abs(
         jnp.concatenate([jnp.zeros((1,), dt), e]))
     lo0 = jnp.min(d - r)
     hi0 = jnp.max(d + r)
     span = hi0 - lo0
-    lo = jnp.full((n,), lo0, dt)
-    hi = jnp.full((n,), hi0 + jnp.finfo(dt).eps * span, dt)
-    k = jnp.arange(n)
+    k = jnp.arange(il, iu)
+    lo = jnp.full((iu - il,), lo0, dt)
+    hi = jnp.full((iu - il,), hi0 + jnp.finfo(dt).eps * span, dt)
 
     def sweep(_, lohi):
         lo, hi = lohi
@@ -94,6 +109,23 @@ def sterf_bisect(d: jax.Array, e: jax.Array, iters: int | None = None):
 
     lo, hi = lax.fori_loop(0, int(iters), sweep, (lo, hi))
     return 0.5 * (lo + hi) * s
+
+
+@jax.jit
+def sturm_count_interval(d: jax.Array, e: jax.Array, vl, vu) -> jax.Array:
+    """Number of eigenvalues of T(d, e) in the half-open interval [vl, vu) —
+    one fused Sturm-count pass per endpoint (LAPACK stebz range='V''s
+    counting step; the reference has no interval-counting API at all).
+    The Sturm count is strictly-below, so endpoints that coincide with an
+    eigenvalue to rounding are eps-sensitive — pick endpoints in gaps."""
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    dt = d.dtype
+    ds, _, e2, s = _prescale(d, e)
+    x = jnp.stack([jnp.asarray(vl, dt) / s, jnp.asarray(vu, dt) / s])
+    cnt = _sturm_counts(ds, e2, x)
+    # inverted intervals count zero (not negative) — matches the dense path
+    return jnp.maximum(cnt[1] - cnt[0], 0).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("iters",))
