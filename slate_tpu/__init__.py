@@ -34,7 +34,8 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri,
                      potrs, stedc, stedc_deflate, stedc_merge, stedc_secular,
                      stedc_solve, stedc_sort, stedc_z_vector, stein, steqr,
-                     steqr2, sterf, sterf_bisect, svd, svd_vals, syev, sygst,
+                     steqr2, sterf, sterf_bisect, svd, svd_range, svd_vals,
+                     syev, sygst,
                      sygv, sysv, sytrf,
                      sytrs, tb2bd, tbsm, tbsm_pivots, tbsmPivots, trcondest,
                      trtri, trtrm, unmbr_ge2tb,

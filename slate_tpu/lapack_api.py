@@ -284,6 +284,21 @@ def _heevx(dt, jobz, uplo, a, il, iu, *, sy=False):
             else (np.asarray(lam), None))
 
 
+def _gesvdx(dt, jobu, jobvt, a, il, iu):
+    """LAPACK gesvdx range='I' (1-based inclusive il..iu of the DESCENDING
+    singular values): subset/top-k SVD — another family the reference's
+    lapack_api does not cover."""
+    (a,) = _as(dt, a)
+    from .linalg.svd import svd_range
+
+    want = jobu.lower() == "v" or jobvt.lower() == "v"
+    S, U, VT = svd_range(a, _opts(), il=int(il) - 1, iu=int(iu),
+                         want_vectors=want)
+    return (np.asarray(S),
+            np.asarray(U) if want and jobu.lower() == "v" else None,
+            np.asarray(VT) if want and jobvt.lower() == "v" else None)
+
+
 def _hegv(dt, itype, jobz, uplo, a, b, *, sy=False):
     a, b = _as(dt, a, b)
     lam, z = _la.hegv(int(itype), a, b, _opts(), uplo=uplo,
@@ -391,6 +406,7 @@ _FAMILIES = {
     "heev": (_heev, {}), "heevd": (_heev, {}),
     "syev": (_heev, {"sy": True}), "syevd": (_heev, {"sy": True}),
     "heevx": (_heevx, {}), "syevx": (_heevx, {"sy": True}),
+    "gesvdx": (_gesvdx, {}),
     "hegv": (_hegv, {}), "sygv": (_hegv, {"sy": True}),
     "gesvd": (_gesvd, {}),
     "pbsv": (_pbsv, {}), "pbtrf": (_pbtrf, {}), "pbtrs": (_pbtrs, {}),

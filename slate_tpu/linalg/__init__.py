@@ -15,7 +15,7 @@ from .stedc import (stedc_deflate, stedc_merge, stedc_secular, stedc_solve,
 from .eig import (eig_count, hb2st, he2hb, he2hb_q, heev, heev_range,
                   hegst, hegv, stedc, steqr,
                   steqr2, sterf, syev, sygst, sygv, unmtr_hb2st, unmtr_he2hb)
-from .svd import (bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
+from .svd import (svd_range, bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)
 from .condest import gecondest, norm1est, pocondest, trcondest
 from .sturm import stein, sterf_bisect

@@ -138,6 +138,117 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
     return S, (U if want_u else None), (VT if want_vt else None)
 
 
+def _gk_form(d, e):
+    """Golub–Kahan form of the bidiagonal B(d, e): the 2k symmetric
+    tridiagonal with zero diagonal and interleaved (d_0, e_0, d_1, …)
+    off-diagonal, whose eigenvalues are ±σ_i (the bdsvdx/stebz route)."""
+    k = d.shape[0]
+    tgk_off = jnp.zeros((2 * k - 1,), d.dtype)
+    tgk_off = tgk_off.at[0::2].set(d)
+    if k > 1:
+        tgk_off = tgk_off.at[1::2].set(e)
+    return jnp.zeros((2 * k,), d.dtype), tgk_off
+
+
+def _gk_split(Z, dtype):
+    """Split TGK eigenvectors for +σ into the (U, V) singular-vector pair:
+    z[0::2] = v/√2, z[1::2] = u/√2; renormalize (near-degenerate ±σ pairs
+    can leak norm between the halves)."""
+    root2 = jnp.asarray(jnp.sqrt(2.0), jnp.real(Z).dtype)
+    V = root2 * Z[0::2, :]
+    U = root2 * Z[1::2, :]
+
+    def renorm(M):
+        nrm = jnp.linalg.norm(M, axis=0, keepdims=True)
+        return (M / jnp.where(nrm > 0, nrm, 1.0)).astype(dtype)
+
+    return renorm(U), renorm(V)
+
+
+def svd_range(A, opts=None, *, il: int = 0, iu: Optional[int] = None,
+              want_vectors: bool = True, chase_pipeline: bool = False):
+    """Subset SVD: the singular values with DESCENDING indices [il, iu)
+    (il=0 is the largest) and, optionally, their U/V columns — the top-k
+    SVD as a first-class driver (no reference analogue; SLATE's svd always
+    computes the full spectrum).
+
+    Route: two-stage reduction (ge2tb O(mn·nb) gemms) -> bidiagonal chase
+    -> index-targeted Sturm bisection on the Golub–Kahan form (only the
+    2j target indices of the ±σ spectrum bracket, O(n·j) work) -> ``stein``
+    inverse iteration for the j interleaved TGK vectors -> both chase
+    back-transforms applied to the THIN (n, j) blocks via the reverse
+    sweep accumulation -> thin stage-1 back-transforms.  Vectors cost
+    O(mn·(nb + j)) vs the full solve's O(mn²).
+
+    Returns ``(S, U, VT)`` with S (j,) descending, U (m, j), VT (j, n)
+    (None when ``want_vectors=False``).  Accuracy is bisection's ABSOLUTE
+    envelope O(eps·σ_max) — exactly right for top-k use.
+    """
+    opts = Options.make(opts)
+    a = as_array(A)
+    m, n = a.shape[-2:]
+    if m < n:
+        S, V, UT = svd_range(jnp.conj(a).T, opts, il=il, iu=iu,
+                             want_vectors=want_vectors,
+                             chase_pipeline=chase_pipeline)
+        if not want_vectors:
+            return S, None, None
+        return S, jnp.conj(UT).T, jnp.conj(V).T
+    k = n
+    if iu is None:
+        iu = k
+    slate_assert(0 <= il < iu <= k,
+                 f"index range [{il}, {iu}) invalid for min(m,n)={k}")
+    j = iu - il
+    if k < 8:
+        if want_vectors:
+            out = jnp.linalg.svd(a, full_matrices=False)
+            return out[1][il:iu], out[0][:, il:iu], out[2][il:iu, :]
+        return jnp.linalg.svd(a, compute_uv=False)[il:iu], None, None
+    from .eig import default_band_nb
+    from .sturm import stein, sterf_bisect
+
+    with trace_block("svd_range", m=m, n=n, k=j):
+        a, factor = _safe_scale(a)
+        nb = default_band_nb(k, opts)
+        nb = int(max(2, min(nb, max(2, k - 1))))
+        band, Uf, Vf = ge2tb_band(a, opts, nb=nb)
+        sq = band[:k, :k]
+        if want_vectors:
+            d_c, e_c, Us, tauus, Vcs, tauvs = tb2bd_reflectors(
+                sq, nb, pipeline=chase_pipeline)
+        else:
+            d_c, e_c, *_ = _tb2bd_run_chase(sq, nb, chase_pipeline)
+        d, e = jnp.abs(d_c), jnp.abs(e_c)
+        # Golub–Kahan form: eigenvalues are ±σ ascending; descending σ
+        # indices [il, iu) are TGK ascending indices [2k-iu, 2k-il)
+        zero_d, tgk_off = _gk_form(d, e)
+        lam_desc = sterf_bisect(zero_d, tgk_off,
+                                il=2 * k - iu, iu=2 * k - il)[::-1]
+        sig = jnp.maximum(lam_desc, 0.0)
+        if not want_vectors:
+            return sig * factor, None, None
+        Z = stein(zero_d, tgk_off, lam_desc)       # (2k, j), +σ descending
+        U2t, V2t = _gk_split(Z, sq.dtype)
+        # chase back-transforms on the thin blocks: U2 = Qu_raw · diag(pu),
+        # so U2 @ X = Qu_raw @ (pu ⊙ X) via the reverse sweep accumulation
+        from .householder import sweep_accumulate
+
+        pu, pw = _bidiag_phases(d_c, e_c, sq.dtype)
+        Xu = pu[:, None] * U2t
+        Xv = pw[:, None] * V2t
+        Uu = jnp.conj(sweep_accumulate(Us, tauus, k, nb,
+                                       Q0=jnp.conj(Xu).T, reverse=True)).T
+        Vv = jnp.conj(sweep_accumulate(Vcs, tauvs, k, nb,
+                                       Q0=jnp.conj(Xv).T, reverse=True)).T
+        # thin stage-1 back-transforms
+        U = jnp.zeros((m, j), sq.dtype).at[:k, :].set(Uu)
+        U = unmbr_ge2tb_factors("left", "n", Uf, U)
+        Vfull = jnp.zeros((n, j), sq.dtype).at[:k, :].set(Vv)
+        Vfull = unmbr_ge2tb_factors("left", "n", Vf, Vfull)
+        return sig * factor, U, jnp.conj(Vfull).T
+
+
 def svd_vals(A, opts=None):
     """Singular values only (src/svd.cc svd_vals entry)."""
     S, _, _ = svd(A, opts, want_u=False, want_vt=False)
@@ -622,11 +733,7 @@ def bdsqr(d, e, opts=None, want_vectors: bool = False, method: str = "auto"):
     if use_bisect:
         from .sturm import stein, sterf_bisect
 
-        tgk_off = jnp.zeros((2 * k - 1,), d.dtype)
-        tgk_off = tgk_off.at[0::2].set(d)
-        if k > 1:
-            tgk_off = tgk_off.at[1::2].set(e)
-        zero_d = jnp.zeros((2 * k,), d.dtype)
+        zero_d, tgk_off = _gk_form(d, e)
         lam = sterf_bisect(zero_d, tgk_off)
         # +σ branch, descending; clamp the ~eps·||B|| bisection noise at σ≈0
         sig = jnp.maximum(lam[k:][::-1], 0.0)
@@ -639,15 +746,8 @@ def bdsqr(d, e, opts=None, want_vectors: bool = False, method: str = "auto"):
         # accuracy envelope: σ within O(eps·σ_max) of zero have no relative
         # digits and their u/v split degrades (the ±σ TGK pair merges).
         Z = stein(zero_d, tgk_off, lam[k:][::-1])
-        root2 = jnp.asarray(jnp.sqrt(2.0), d.dtype)
-        V = root2 * Z[0::2, :]
-        U = root2 * Z[1::2, :]
-
-        def _renorm(M):
-            nrm = jnp.linalg.norm(M, axis=0, keepdims=True)
-            return M / jnp.where(nrm > 0, nrm, 1.0)
-
-        return sig, _renorm(U), jnp.swapaxes(_renorm(V), -1, -2)
+        U, V = _gk_split(Z, Z.dtype)
+        return sig, U, jnp.swapaxes(V, -1, -2)
     B = jnp.zeros((k, k), dtype=d.dtype)
     idx = jnp.arange(k)
     B = B.at[idx, idx].set(d)

@@ -67,6 +67,48 @@ def test_eig_count(rng):
     assert int(c_all) == n
 
 
+@pytest.mark.parametrize("m,n", [(96, 96), (128, 64), (64, 128)])
+def test_svd_range_topk(rng, m, n):
+    """Top-k and interior singular triplets match the full SVD."""
+    A = jnp.asarray(rng.standard_normal((m, n)))
+    Sref = np.linalg.svd(np.asarray(A), compute_uv=False)
+    for il, iu in [(0, 5), (10, 20)]:
+        S, U, VT = slate.svd_range(A, il=il, iu=iu)
+        assert np.max(np.abs(np.asarray(S) - Sref[il:iu])) < 1e-10
+        rec = (np.asarray(A) @ np.asarray(VT).conj().T
+               - np.asarray(U) * np.asarray(S)[None, :])
+        assert np.linalg.norm(rec) < 1e-9 * max(m, n)
+        orthU = np.linalg.norm(np.asarray(U).conj().T @ np.asarray(U)
+                               - np.eye(iu - il))
+        assert orthU < 1e-9
+        S2, u_none, v_none = slate.svd_range(A, il=il, iu=iu,
+                                             want_vectors=False)
+        assert u_none is None and v_none is None
+        assert np.max(np.abs(np.asarray(S2) - Sref[il:iu])) < 1e-10
+
+
+def test_svd_range_complex(rng):
+    n = 64
+    A = jnp.asarray(rng.standard_normal((n, n))
+                    + 1j * rng.standard_normal((n, n)))
+    Sref = np.linalg.svd(np.asarray(A), compute_uv=False)
+    S, U, VT = slate.svd_range(A, il=0, iu=6)
+    assert np.max(np.abs(np.asarray(S) - Sref[:6])) < 1e-10
+    rec = (np.asarray(A) @ np.asarray(VT).conj().T
+           - np.asarray(U) * np.asarray(S)[None, :])
+    assert np.linalg.norm(rec) < 1e-9 * n
+
+
+def test_lapack_skin_gesvdx(rng):
+    from slate_tpu import lapack_api as lp
+
+    A = rng.standard_normal((48, 32))
+    ref = np.linalg.svd(A, compute_uv=False)
+    S, U, VT = lp.dgesvdx("V", "V", A.copy(), 1, 5)   # 1-based inclusive
+    assert S.shape == (5,) and np.max(np.abs(S - ref[:5])) < 1e-11
+    assert np.linalg.norm(A @ VT.T - U * S[None, :]) < 1e-10
+
+
 def test_lapack_skin_syevx(rng):
     """dsyevx/zheevx: LAPACK 1-based inclusive index range."""
     from slate_tpu import lapack_api as lp
