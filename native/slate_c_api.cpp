@@ -812,6 +812,72 @@ int slate_strsm(char side, char uplo, char transa, char diag, int64_t m,
                    ldb, 4);
 }
 
+int slate_dsyevx(char jobz, char uplo, int64_t n, double* A, int64_t lda,
+                 int64_t il, int64_t iu, double* W, double* Z, int64_t ldz) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t k = iu - il + 1;
+  if (k < 1 || il < 1 || iu > n) return -1;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Wbuf", W, k * 8);
+  if (Z != nullptr) set_mem(c.locals, "Zbuf", Z, ldz * k * 8);
+  set_chr(c.locals, "jobz", jobz);
+  set_chr(c.locals, "uplo", uplo);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "il", il);
+  set_int(c.locals, "iu", iu);
+  set_int(c.locals, "ldz", ldz);
+  return run_code(
+      "from slate_tpu import lapack_api as _lp\n"
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:n, :n]\n"
+      "lam, z = _lp.dsyevx(jobz, uplo, a.copy(), il, iu)\n"
+      "k = iu - il + 1\n"
+      "np.frombuffer(Wbuf, np.float64)[:k] = np.asarray(lam)\n"
+      "if z is not None and 'Zbuf' in dir():\n"
+      "    zf = np.frombuffer(Zbuf, np.float64).reshape((ldz, -1), order='F')\n"
+      "    zf[:n, :k] = np.asarray(z)\n"
+      "info = 0\n",
+      c.locals);
+}
+
+int slate_dgesvdx(char jobu, char jobvt, int64_t m, int64_t n, double* A,
+                  int64_t lda, int64_t il, int64_t iu, double* S,
+                  double* U, int64_t ldu, double* VT, int64_t ldvt) {
+  Call c;
+  if (!c.ok) return -999;
+  int64_t kmin = m < n ? m : n;
+  int64_t k = iu - il + 1;
+  if (k < 1 || il < 1 || iu > kmin) return -1;
+  set_mem(c.locals, "Abuf", A, lda * n * 8);
+  set_mem(c.locals, "Sbuf", S, k * 8);
+  if (U != nullptr) set_mem(c.locals, "Ubuf", U, ldu * k * 8);
+  if (VT != nullptr) set_mem(c.locals, "Vbuf", VT, ldvt * n * 8);
+  set_chr(c.locals, "jobu", jobu);
+  set_chr(c.locals, "jobvt", jobvt);
+  set_int(c.locals, "m", m);
+  set_int(c.locals, "n", n);
+  set_int(c.locals, "lda", lda);
+  set_int(c.locals, "il", il);
+  set_int(c.locals, "iu", iu);
+  set_int(c.locals, "ldu", ldu);
+  set_int(c.locals, "ldvt", ldvt);
+  return run_code(
+      "from slate_tpu import lapack_api as _lp\n"
+      "a = np.frombuffer(Abuf, np.float64).reshape((lda, -1), order='F')[:m, :n]\n"
+      "s, u, vt = _lp.dgesvdx(jobu, jobvt, a.copy(), il, iu)\n"
+      "k = iu - il + 1\n"
+      "np.frombuffer(Sbuf, np.float64)[:k] = np.asarray(s)\n"
+      "if u is not None and 'Ubuf' in dir():\n"
+      "    uf = np.frombuffer(Ubuf, np.float64).reshape((ldu, -1), order='F')\n"
+      "    uf[:m, :k] = np.asarray(u)\n"
+      "if vt is not None and 'Vbuf' in dir():\n"
+      "    vf = np.frombuffer(Vbuf, np.float64).reshape((ldvt, -1), order='F')\n"
+      "    vf[:k, :n] = np.asarray(vt)\n"
+      "info = 0\n",
+      c.locals);
+}
+
 int slate_dsygv(int64_t itype, char jobz, char uplo, int64_t n, double* A,
                 int64_t lda, double* B, int64_t ldb, double* W) {
   Call c;

@@ -128,6 +128,60 @@ int main(void) {
     free(A); free(As); free(W);
   }
 
+  /* syevx: subset eigenpairs (indices 2..5, 1-based inclusive) */
+  {
+    int64_t il = 2, iu = 5, k = iu - il + 1;
+    double *A = malloc(n * n * 8), *As = malloc(n * n * 8);
+    double *W = malloc(n * 8), *Wx = malloc(k * 8), *Z = malloc(n * k * 8);
+    for (int64_t j = 0; j < n; ++j)
+      for (int64_t i = 0; i <= j; ++i) {
+        double v = frand();
+        A[i + j * n] = A[j + i * n] = v;
+      }
+    for (int64_t i = 0; i < n * n; ++i) As[i] = A[i];
+    int info = slate_dsyev('n', 'l', n, A, n, W);     /* full, for reference */
+    int infox = slate_dsyevx('v', 'l', n, As, n, il, iu, Wx, Z, n);
+    double maxe = (info == 0 && infox == 0) ? 0 : 1e9;
+    for (int64_t j = 0; j < k; ++j) {
+      double d = fabs(Wx[j] - W[il - 1 + j]);
+      if (d > maxe) maxe = d;
+      for (int64_t i = 0; i < n; ++i) {
+        double acc = 0;
+        for (int64_t kk = 0; kk < n; ++kk)
+          acc += As[i + kk * n] * Z[kk + j * n];
+        double r = fabs(acc - Wx[j] * Z[i + j * n]);
+        if (r > maxe) maxe = r;
+      }
+    }
+    fails += check("dsyevx", maxe, 1e-8);
+    free(A); free(As); free(W); free(Wx); free(Z);
+  }
+
+  /* gesvdx: top-3 singular triplets */
+  {
+    int64_t k = 3;
+    double *A = malloc(m * n * 8), *As = malloc(m * n * 8);
+    double *Sf = malloc(n * 8), *Sx = malloc(k * 8);
+    double *U = malloc(m * k * 8), *VT = malloc(k * n * 8);
+    for (int64_t i = 0; i < m * n; ++i) A[i] = As[i] = frand();
+    int info = slate_dgesvd('n', 'n', m, n, A, m, Sf, NULL, m, NULL, n);
+    int infox = slate_dgesvdx('v', 'v', m, n, As, m, 1, k, Sx, U, m, VT, k);
+    double maxe = (info == 0 && infox == 0) ? 0 : 1e9;
+    for (int64_t j = 0; j < k; ++j) {
+      double d = fabs(Sx[j] - Sf[j]);
+      if (d > maxe) maxe = d;
+      for (int64_t i = 0; i < m; ++i) {
+        double acc = 0;                          /* (A v_j - s_j u_j)_i */
+        for (int64_t kk = 0; kk < n; ++kk)
+          acc += As[i + kk * m] * VT[j + kk * k];
+        double r = fabs(acc - Sx[j] * U[i + j * m]);
+        if (r > maxe) maxe = r;
+      }
+    }
+    fails += check("dgesvdx", maxe, 1e-8);
+    free(A); free(As); free(Sf); free(Sx); free(U); free(VT);
+  }
+
   /* gesvd */
   {
     int64_t k = n;
