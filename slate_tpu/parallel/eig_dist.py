@@ -187,6 +187,102 @@ def unmtr_he2hb_distributed(Vs: jax.Array, Ts: jax.Array, C: jax.Array,
     return out[:n]
 
 
+def _twostage_stage12(A, grid: ProcessGrid, nb: int,
+                      chase_pipeline: bool, chase_distributed: bool,
+                      want_tape: bool):
+    """Shared two-stage prologue for the distributed eig drivers: nb clamps,
+    safe scaling, sharded stage 1, band replication, and the chase (with the
+    segment-parallel eligibility floor applied in ONE place so the full and
+    subset drivers cannot diverge).
+
+    Returns ``(d, e_c, Vcs, tcs, Vs1, Ts1, factor)``; with
+    ``want_tape=False`` the reflector tape entries are None and ``e_c`` is
+    already the real |e|."""
+    from ..linalg.eig import _safe_scale, hb2st, hb2st_reflectors
+
+    n = A.shape[-1]
+    nb = max(2, min(nb, max(2, n // 2)))
+    # clamp against the nb·nprocs padding granularity: pad stays ≤ ~n/4, so
+    # the O(n²·nb) stage-1 gemms never run on a matrix 2× the real linear
+    # size for unaligned n (the chase below uses the same clamped kd)
+    nprocs = grid.p * grid.q
+    if n >= 8 * nprocs:
+        nb = max(2, min(nb, -(-n // (4 * nprocs))))
+    a, factor = _safe_scale(A)
+    # stage 1 on the mesh: explicit shard_map panel pipeline (he2hb.cc)
+    band, Vs1, Ts1 = he2hb_distributed(a, grid, nb=nb)
+    # he2hbGather analogue: replicate the (cheap) band for the local chase
+    band = jax.device_put(band, grid.replicated())
+    nband = band.shape[-1]
+    use_dist_chase = (chase_distributed and nb >= 2 and nband > 2
+                      and -(-nband // nprocs) >= 2 * nb + 2)
+    if use_dist_chase:
+        from .chase_dist import hb2st_chase_distributed
+
+        d, e_c, Vcs, tcs = hb2st_chase_distributed(band, nb, grid,
+                                                   want_vectors=want_tape)
+        if not want_tape:
+            return d, jnp.abs(e_c), None, None, Vs1, Ts1, factor, nb
+    elif want_tape:
+        d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
+                                            pipeline=chase_pipeline)
+    else:
+        d, e = hb2st(band, kd=nb, want_vectors=False,
+                     pipeline=chase_pipeline)
+        return d, e, None, None, Vs1, Ts1, factor, nb
+    if not want_tape:
+        return d, jnp.abs(e_c), None, None, Vs1, Ts1, factor, nb
+    return d, e_c, Vcs, tcs, Vs1, Ts1, factor, nb
+
+
+def heev_range_distributed(A: jax.Array, grid: ProcessGrid, il: int, iu: int,
+                           nb: int = 64, want_vectors: bool = True,
+                           chase_pipeline: bool = False,
+                           chase_distributed: bool = False):
+    """Distributed subset eigensolve: the k = iu-il eigenpairs with ascending
+    indices [il, iu) over the mesh (no reference analogue at any scale).
+
+    Stage 1 (the O(n²·nb) flops) runs sharded (he2hb_distributed); the
+    chase runs replicated or segment-parallel per ``chase_distributed``;
+    the subset tridiagonal work is O(n·k) bisection + stein; the chase
+    back-transform applies Q2 to the THIN (n, k) block via the reverse
+    sweep accumulation (replicated — O(n²·k/b) total, small next to stage
+    1); and the stage-1 back-transform rides the mesh
+    (unmtr_he2hb_distributed on k columns, one psum per block).
+    Returns (lam (k,), Z (n, k) row-sharded or None).
+    """
+    from ..core.exceptions import slate_assert
+    from ..linalg.eig import _phase_vector
+    from ..linalg.householder import sweep_accumulate
+    from ..linalg.sturm import stein, sterf_bisect
+
+    n = A.shape[-1]
+    slate_assert(0 <= il < iu <= n,
+                 f"index range [{il}, {iu}) invalid for n={n}")
+    if n < 8:
+        lam, z = jnp.linalg.eigh(A)
+        return (lam[il:iu], z[:, il:iu]) if want_vectors \
+            else (lam[il:iu], None)
+    if not want_vectors:
+        d, e, _, _, _, _, factor, _ = _twostage_stage12(
+            A, grid, nb, chase_pipeline, chase_distributed, want_tape=False)
+        lam = sterf_bisect(d, e, il=il, iu=iu)
+        return lam * factor, None
+    d, e_c, Vcs, tcs, Vs1, Ts1, factor, nb_eff = _twostage_stage12(
+        A, grid, nb, chase_pipeline, chase_distributed, want_tape=True)
+    e = jnp.abs(e_c)
+    lam = sterf_bisect(d, e, il=il, iu=iu)
+    dt = Vcs.dtype
+    Zt = stein(d, e, lam).astype(dt)
+    ph = _phase_vector(e_c.astype(dt))
+    X = ph[:, None] * Zt
+    nband = d.shape[0]
+    z = jnp.conj(sweep_accumulate(Vcs, tcs, nband, nb_eff,
+                                  Q0=jnp.conj(X).T, reverse=True)).T
+    z = unmtr_he2hb_distributed(Vs1, Ts1, z[:n], grid, conj_q=False)
+    return lam * factor, z
+
+
 @lru_cache(maxsize=32)
 def _ge2tb_shard_fn(mesh, mpad: int, npc: int, nreal: int, nb: int,
                     dtype_str: str):
@@ -320,7 +416,7 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     (heev.cc:137-160).  Requires n/P >= 2*nb+2 (falls back to the
     replicated chase below that floor).
     """
-    from ..linalg.eig import _safe_scale, hb2st, sterf
+    from ..linalg.eig import sterf
     from ..linalg.stedc import stedc as _stedc
 
     n = A.shape[-1]
@@ -330,31 +426,9 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
         lam, z = (jnp.linalg.eigh(A) if want_vectors
                   else (jnp.linalg.eigvalsh(A), None))
         return lam, z
-    nb = max(2, min(nb, max(2, n // 2)))
-    # clamp against the nb·nprocs padding granularity: pad stays ≤ ~n/4, so
-    # the O(n²·nb) stage-1 gemms never run on a matrix 2× the real linear
-    # size for unaligned n (the chase below uses the same clamped kd)
-    nprocs = grid.p * grid.q
-    if n >= 8 * nprocs:
-        nb = max(2, min(nb, -(-n // (4 * nprocs))))
-    a, factor = _safe_scale(A)
-    # stage 1 on the mesh: explicit shard_map panel pipeline (he2hb.cc)
-    band, Vs, Ts = he2hb_distributed(a, grid, nb=nb)
-    # he2hbGather analogue: replicate the (cheap) band for the local chase
-    band = jax.device_put(band, grid.replicated())
-    nband = band.shape[-1]
-    use_dist_chase = (chase_distributed and nb >= 2 and nband > 2
-                      and -(-nband // (grid.p * grid.q)) >= 2 * nb + 2)
-    if use_dist_chase:
-        from .chase_dist import hb2st_chase_distributed
     if not want_vectors:
-        if use_dist_chase:
-            d, e_c, _, _ = hb2st_chase_distributed(band, nb, grid,
-                                                   want_vectors=False)
-            e = jnp.abs(e_c)
-        else:
-            d, e = hb2st(band, kd=nb, want_vectors=False,
-                         pipeline=chase_pipeline)
+        d, e, _, _, _, _, factor, _ = _twostage_stage12(
+            A, grid, nb, chase_pipeline, chase_distributed, want_tape=False)
         # values-only always takes sterf — D&C inherently carries vectors
         # (merge z-couplings ARE eigenvector rows), exactly why the reference
         # routes no-vector solves to sterf too (heev.cc:208-215)
@@ -363,16 +437,10 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     # vectors: the chase tape is the cheap O(n² kd) part and replays
     # replicated; the Q2 accumulation — 97% of the profiled vectors time —
     # shards over mesh rows with zero collectives (round-5; was replicated)
-    from ..linalg.eig import hb2st_reflectors
-
-    if use_dist_chase:
-        d, e_c, Vcs, tcs = hb2st_chase_distributed(band, nb, grid,
-                                                   want_vectors=True)
-    else:
-        d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
-                                            pipeline=chase_pipeline)
+    d, e_c, Vcs, tcs, Vs, Ts, factor, nb = _twostage_stage12(
+        A, grid, nb, chase_pipeline, chase_distributed, want_tape=True)
     e = jnp.abs(e_c)
-    Q2 = hb2st_q_distributed(Vcs, tcs, e_c, band.shape[-1], grid)
+    Q2 = hb2st_q_distributed(Vcs, tcs, e_c, d.shape[0], grid)
     if method_eig == "bisection":
         # bisection values + batched inverse-iteration vectors (the method
         # the reference leaves unimplemented, enums.hh:363); the vmapped

@@ -249,6 +249,19 @@ def _pheev_distributed(dt, jobz, uplo, a):
     return np.asarray(lam), (np.asarray(z) if want else None)
 
 
+def _pheevx_distributed(dt, jobz, uplo, a, il, iu):
+    """p?syevx/p?heevx (range='I', 1-based inclusive like ScaLAPACK's
+    pdsyevx): distributed subset eigensolve — sharded stage 1, subset
+    bisection, thin back-transforms (parallel.heev_range_distributed)."""
+    from .parallel import heev_range_distributed
+
+    full = _sym_full(uplo, np.asarray(a, dtype=dt))
+    want = jobz.lower() == "v"
+    lam, z = heev_range_distributed(_jnp(full), _grid, int(il) - 1, int(iu),
+                                    nb=_nb(), want_vectors=want)
+    return np.asarray(lam), (np.asarray(z) if want else None)
+
+
 def _pgesvd_distributed(dt, jobu, jobvt, a):
     from .parallel import svd_distributed
 
@@ -428,6 +441,8 @@ _DISTRIBUTED = {
     "heevd": _pheev_distributed,
     "syev": _pheev_distributed,
     "syevd": _pheev_distributed,
+    "heevx": _pheevx_distributed,
+    "syevx": _pheevx_distributed,
     "gesvd": _pgesvd_distributed,
     "lange": _plange_distributed,
     "lanhe": _planhe_distributed,
