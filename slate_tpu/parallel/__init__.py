@@ -35,7 +35,7 @@ from .eig_dist import (heev_distributed, hegv_distributed, svd_distributed,
                        norm_distributed, col_norms_distributed,
                        he2hb_distributed, ge2tb_distributed,
                        unmtr_he2hb_distributed, steqr_distributed,
-                       heev_range_distributed)
+                       heev_range_distributed, svd_range_distributed)
 from .chase_dist import (hb2st_chase_distributed,
                          tb2bd_chase_distributed)
 from .inverse import (trtri_distributed, trtrm_distributed, potri_distributed,

@@ -271,6 +271,20 @@ def _pgesvd_distributed(dt, jobu, jobvt, a):
     return _lapi._svd_finish(S, U, VT, jobu, jobvt, *a.shape)
 
 
+def _pgesvdx_distributed(dt, jobu, jobvt, a, il, iu):
+    """p?gesvdx (range='I', 1-based inclusive of the DESCENDING singular
+    values): distributed top-k SVD (parallel.svd_range_distributed)."""
+    from .parallel import svd_range_distributed
+
+    a = np.asarray(a, dtype=dt)
+    want = jobu.lower() == "v" or jobvt.lower() == "v"
+    S, U, VT = svd_range_distributed(_jnp(a), _grid, int(il) - 1, int(iu),
+                                     nb=_nb(), want_vectors=want)
+    return (np.asarray(S),
+            np.asarray(U) if want and jobu.lower() == "v" else None,
+            np.asarray(VT) if want and jobvt.lower() == "v" else None)
+
+
 def _plange_distributed(dt, norm, a):
     from .parallel import norm_distributed
 
@@ -444,6 +458,7 @@ _DISTRIBUTED = {
     "heevx": _pheevx_distributed,
     "syevx": _pheevx_distributed,
     "gesvd": _pgesvd_distributed,
+    "gesvdx": _pgesvdx_distributed,
     "lange": _plange_distributed,
     "lanhe": _planhe_distributed,
     "lansy": _plansy_distributed,
