@@ -29,7 +29,7 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt, getrf,
                      getrf_nopiv, getrf_tntpiv, getri, getri_oop, getrs,
                      getrs_nopiv, hb2st, hbmm, he2hb, he2hb_q, heev,
-                     heev_range, eig_count, hegst,
+                     heev_range, eig_count, hegst, hegv_range,
                      hegv, hesv, hetrf, hetrs, norm1est, pbsv, pbtrf, pbtrs,
                      pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri,
                      potrs, stedc, stedc_deflate, stedc_merge, stedc_secular,

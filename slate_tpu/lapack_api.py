@@ -284,6 +284,19 @@ def _heevx(dt, jobz, uplo, a, il, iu, *, sy=False):
             else (np.asarray(lam), None))
 
 
+def _hegvx(dt, itype, jobz, uplo, a, b, il, iu, *, sy=False):
+    """LAPACK hegvx/sygvx range='I' (1-based inclusive): generalized subset
+    eigensolve — another family the reference's lapack_api lacks."""
+    a, b = _as(dt, a, b)
+    from .linalg.eig import hegv_range
+
+    lam, z = hegv_range(int(itype), a, b, _opts(), uplo=uplo,
+                        il=int(il) - 1, iu=int(iu),
+                        want_vectors=jobz.lower() == "v")
+    return ((np.asarray(lam), np.asarray(z)) if jobz.lower() == "v"
+            else (np.asarray(lam), None))
+
+
 def _gesvdx(dt, jobu, jobvt, a, il, iu):
     """LAPACK gesvdx range='I' (1-based inclusive il..iu of the DESCENDING
     singular values): subset/top-k SVD — another family the reference's
@@ -408,6 +421,7 @@ _FAMILIES = {
     "heevx": (_heevx, {}), "syevx": (_heevx, {"sy": True}),
     "gesvdx": (_gesvdx, {}),
     "hegv": (_hegv, {}), "sygv": (_hegv, {"sy": True}),
+    "hegvx": (_hegvx, {}), "sygvx": (_hegvx, {"sy": True}),
     "gesvd": (_gesvd, {}),
     "pbsv": (_pbsv, {}), "pbtrf": (_pbtrf, {}), "pbtrs": (_pbtrs, {}),
     "gbsv": (_gbsv, {}),
@@ -422,6 +436,7 @@ _SKIP = {
     ("c", "syev"), ("z", "syev"), ("c", "syevd"), ("z", "syevd"),
     ("s", "heevx"), ("d", "heevx"), ("c", "syevx"), ("z", "syevx"),
     ("s", "hegv"), ("d", "hegv"), ("c", "sygv"), ("z", "sygv"),
+    ("s", "hegvx"), ("d", "hegvx"), ("c", "sygvx"), ("z", "sygvx"),
     ("s", "hesv"), ("d", "hesv"),   # LAPACK: ssysv/dsysv but chesv/zhesv
     # LAPACK's csysv/zsysv solve complex *symmetric* (A == A.T) systems;
     # the backend's indefinite solver is Hermitian CA-Aasen — exposing the

@@ -13,7 +13,7 @@ from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
 from .stedc import (stedc_deflate, stedc_merge, stedc_secular, stedc_solve,
                     stedc_sort, stedc_z_vector)
 from .eig import (eig_count, hb2st, he2hb, he2hb_q, heev, heev_range,
-                  hegst, hegv, stedc, steqr,
+                  hegst, hegv, hegv_range, stedc, steqr,
                   steqr2, sterf, syev, sygst, sygv, unmtr_hb2st, unmtr_he2hb)
 from .svd import (svd_range, bdsqr, ge2tb, ge2tb_band, svd, svd_vals, tb2bd,
                   unmbr_ge2tb, unmbr_ge2tb_factors, unmbr_tb2bd)

@@ -255,26 +255,54 @@ def hegst(itype: int, A, B_factor, opts=None, uplo=None):
     raise SlateError(f"hegst itype must be 1, 2, or 3, got {itype}")
 
 
-def hegv(itype: int, A, B, opts=None, uplo=None, want_vectors: bool = True):
-    """Generalized Hermitian eigensolve A x = lambda B x (src/hegv.cc:
-    potrf(B) -> hegst -> heev -> back-transform)."""
-    opts = Options.make(opts)
+def _hegv_pipeline(itype: int, A, B, opts, uplo, want_vectors, solve,
+                   label: str):
+    """Shared generalized-eigensolve body (src/hegv.cc): potrf(B) -> hegst ->
+    ``solve`` on the standard form -> itype-dispatched back-transform.
+    ``solve(C)`` returns (lam, z or None)."""
     b = _full_herm(B, uplo)
-    with trace_block("hegv", n=b.shape[-1]):
+    with trace_block(label, n=b.shape[-1]):
         L, info = potrf(b, opts)
         if int(info) != 0:
-            raise SlateError(f"hegv: B not positive definite (info={int(info)})")
+            raise SlateError(
+                f"{label}: B not positive definite (info={int(info)})")
         C = hegst(itype, A, L, opts, uplo)
-        lam, z = heev(C, opts, uplo="lower", want_vectors=want_vectors)
+        lam, z = solve(C)
         if want_vectors:
             if itype in (1, 2):
                 # x = L^{-H} y (LAPACK hegv back-transform for itypes 1 and 2)
-                z = lax.linalg.triangular_solve(L, z, left_side=True, lower=True,
-                                                conjugate_a=True, transpose_a=True)
+                z = lax.linalg.triangular_solve(L, z, left_side=True,
+                                                lower=True, conjugate_a=True,
+                                                transpose_a=True)
             else:
                 # itype=3: x = L y
                 z = jnp.matmul(jnp.tril(L), z, precision=lax.Precision.HIGHEST)
     return lam, (z if want_vectors else None)
+
+
+def hegv(itype: int, A, B, opts=None, uplo=None, want_vectors: bool = True):
+    """Generalized Hermitian eigensolve A x = lambda B x (src/hegv.cc:
+    potrf(B) -> hegst -> heev -> back-transform)."""
+    opts = Options.make(opts)
+    return _hegv_pipeline(
+        itype, A, B, opts, uplo, want_vectors,
+        lambda C: heev(C, opts, uplo="lower", want_vectors=want_vectors),
+        "hegv")
+
+
+def hegv_range(itype: int, A, B, opts=None, uplo=None, *, il: int = 0,
+               iu: Optional[int] = None, want_vectors: bool = True):
+    """Generalized subset eigensolve A x = lambda B x for the eigenvalue
+    INDICES [il, iu) — LAPACK hegvx/sygvx range='I' (another family the
+    reference does not provide).  Same reduction as hegv (potrf(B) ->
+    hegst -> standard subset solve -> back-transform), with the standard
+    stage going through ``heev_range``'s O(n²(nb+k)) pipeline."""
+    opts = Options.make(opts)
+    return _hegv_pipeline(
+        itype, A, B, opts, uplo, want_vectors,
+        lambda C: heev_range(C, opts, uplo="lower", il=il, iu=iu,
+                             want_vectors=want_vectors),
+        "hegv_range")
 
 
 # ---------------------------------------------------------------------------

@@ -559,6 +559,31 @@ def run_heevx(p, slate):
     return _result(p, max(err1, err2), 4.0 * n ** 3 / 3.0, t)
 
 
+@_routine("hegvx", "eig")
+def run_hegvx(p, slate):
+    """Generalized subset eigenpairs (no reference analogue): indices
+    [n/4, n/2) of A x = lam B x; generalized residual + index gate."""
+    n = p["n"]
+    il, iu = n // 4, n // 2
+    A = _herm(n, p)
+    Bm = _gen("randn", n, n, p)
+    B = (Bm @ Bm.conj().T + n * np.eye(n)).astype(p["dtype"])
+    (out), t = time_call(
+        lambda: slate.hegv_range(1, A.copy(), B.copy(), il=il, iu=iu),
+        repeat=p["repeat"])
+    lam, Z = (np.asarray(x) for x in out)
+    err1 = _rel(np.linalg.norm(A @ Z - B @ Z * lam[None, :]),
+                np.linalg.norm(A) + np.linalg.norm(B) * np.max(np.abs(lam)))
+    import scipy.linalg as _sla
+    ref = _sla.eigh(A.astype(np.complex128 if np.iscomplexobj(A)
+                             else np.float64),
+                    B.astype(np.complex128 if np.iscomplexobj(B)
+                             else np.float64), eigvals_only=True)
+    err2 = _rel(np.max(np.abs(lam - ref[il:iu])),
+                max(np.max(np.abs(ref)), 1e-10))
+    return _result(p, max(err1, err2), 4.0 * n ** 3 / 3.0, t)
+
+
 @_routine("gesvdx", "svd")
 def run_gesvdx(p, slate):
     """Top-k singular triplets (no reference analogue): GK-bisection subset

@@ -52,6 +52,48 @@ def test_heev_range_validates(rng):
         slate.heev_range(A, il=8, iu=4)
 
 
+@pytest.mark.parametrize("itype", [1, 2, 3])
+def test_hegv_range(rng, itype):
+    """Generalized subset eigensolve vs scipy.eigh(type=itype)."""
+    import scipy.linalg as sla
+
+    n = 64
+    m = rng.standard_normal((n, n))
+    A = (m + m.T) / 2
+    mb = rng.standard_normal((n, n))
+    B = mb @ mb.T + n * np.eye(n)
+    ref = sla.eigh(A, B, type=itype, eigvals_only=True)
+    lam, Z = slate.hegv_range(itype, jnp.asarray(A), jnp.asarray(B),
+                              il=20, iu=30)
+    assert np.max(np.abs(np.asarray(lam) - ref[20:30])) < 1e-9
+    Zn = np.asarray(Z)
+    lamn = np.asarray(lam)[None, :]
+    if itype == 1:                       # A x = lam B x
+        r = np.linalg.norm(A @ Zn - B @ Zn * lamn)
+    elif itype == 2:                     # A B x = lam x
+        r = np.linalg.norm(A @ (B @ Zn) - Zn * lamn)
+    else:                                # B A x = lam x
+        r = np.linalg.norm(B @ (A @ Zn) - Zn * lamn)
+    assert r < 1e-6 * n * np.linalg.norm(B)
+
+
+def test_lapack_skin_sygvx(rng):
+    from slate_tpu import lapack_api as lp
+    import scipy.linalg as sla
+
+    n = 48
+    m = rng.standard_normal((n, n))
+    A = (m + m.T) / 2
+    mb = rng.standard_normal((n, n))
+    B = mb @ mb.T + n * np.eye(n)
+    ref = sla.eigh(A, B, eigvals_only=True)
+    lam, Z = lp.dsygvx(1, "V", "L", A.copy(), B.copy(), 5, 12)
+    assert lam.shape == (8,)
+    assert np.max(np.abs(lam - ref[4:12])) < 1e-9
+    r = np.linalg.norm(A @ Z - B @ Z * lam[None, :])
+    assert r < 1e-7 * n
+
+
 def test_eig_count(rng):
     n = 96
     m = rng.standard_normal((n, n))
