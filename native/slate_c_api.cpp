@@ -814,10 +814,23 @@ int slate_strsm(char side, char uplo, char transa, char diag, int64_t m,
 
 int slate_dsyevx(char jobz, char uplo, int64_t n, double* A, int64_t lda,
                  int64_t il, int64_t iu, double* W, double* Z, int64_t ldz) {
+  /* LAPACK-style argument validation: info = -(1-based position of the first
+   * invalid argument), checked before the runtime spins up.  jobz='v' with a
+   * NULL Z used to be accepted and silently dropped the vectors with info=0. */
+  bool wantz = (jobz == 'v' || jobz == 'V');
+  if (!wantz && jobz != 'n' && jobz != 'N') return -1;
+  if (uplo != 'l' && uplo != 'L' && uplo != 'u' && uplo != 'U') return -2;
+  if (n < 0) return -3;
+  if (A == nullptr) return -4;
+  if (lda < (n > 1 ? n : 1)) return -5;
+  if (il < 1) return -6;
+  if (iu > n || iu < il) return -7;
+  if (W == nullptr) return -8;
+  if (wantz && Z == nullptr) return -9;
+  if (wantz && ldz < (n > 1 ? n : 1)) return -10;
   Call c;
   if (!c.ok) return -999;
   int64_t k = iu - il + 1;
-  if (k < 1 || il < 1 || iu > n) return -1;
   set_mem(c.locals, "Abuf", A, lda * n * 8);
   set_mem(c.locals, "Wbuf", W, k * 8);
   if (Z != nullptr) set_mem(c.locals, "Zbuf", Z, ldz * k * 8);
@@ -844,11 +857,29 @@ int slate_dsyevx(char jobz, char uplo, int64_t n, double* A, int64_t lda,
 int slate_dgesvdx(char jobu, char jobvt, int64_t m, int64_t n, double* A,
                   int64_t lda, int64_t il, int64_t iu, double* S,
                   double* U, int64_t ldu, double* VT, int64_t ldvt) {
-  Call c;
-  if (!c.ok) return -999;
+  /* LAPACK-style argument validation: info = -(1-based position of the first
+   * invalid argument), checked before the runtime spins up.  jobu/jobvt='v'
+   * with NULL U/VT used to be accepted and silently dropped the vectors with
+   * info=0.  Header contract: U is m x k (ldu >= m), VT is k x n (ldvt >= k). */
+  bool wantu = (jobu == 'v' || jobu == 'V');
+  if (!wantu && jobu != 'n' && jobu != 'N') return -1;
+  bool wantvt = (jobvt == 'v' || jobvt == 'V');
+  if (!wantvt && jobvt != 'n' && jobvt != 'N') return -2;
+  if (m < 0) return -3;
+  if (n < 0) return -4;
+  if (A == nullptr) return -5;
+  if (lda < (m > 1 ? m : 1)) return -6;
   int64_t kmin = m < n ? m : n;
   int64_t k = iu - il + 1;
-  if (k < 1 || il < 1 || iu > kmin) return -1;
+  if (il < 1) return -7;
+  if (iu > kmin || iu < il) return -8;
+  if (S == nullptr) return -9;
+  if (wantu && U == nullptr) return -10;
+  if (wantu && ldu < (m > 1 ? m : 1)) return -11;
+  if (wantvt && VT == nullptr) return -12;
+  if (wantvt && ldvt < (k > 1 ? k : 1)) return -13;
+  Call c;
+  if (!c.ok) return -999;
   set_mem(c.locals, "Abuf", A, lda * n * 8);
   set_mem(c.locals, "Sbuf", S, k * 8);
   if (U != nullptr) set_mem(c.locals, "Ubuf", U, ldu * k * 8);

@@ -13,11 +13,13 @@ verb-style convenience layer mirroring ``include/slate/simplified_api.hh`` lives
 :mod:`slate_tpu.simplified`.
 """
 
-from .core import (BandMatrix, BaseMatrix, Diag, GridOrder, HermitianBandMatrix,
-                   HermitianMatrix, Layout, Matrix, MethodCholQR, MethodEig, MethodGels,
-                   MethodGemm, MethodHemm, MethodLU, MethodSVD, MethodTrsm, Norm,
-                   NormScope, Op, Options, Side, SlateError, SymmetricMatrix, Target,
-                   TileKind, TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
+from .core import (BandMatrix, BaseMatrix, ConvergenceError, Diag, GridOrder,
+                   HermitianBandMatrix, HermitianMatrix, Layout, Matrix,
+                   MethodCholQR, MethodEig, MethodGels, MethodGemm, MethodHemm,
+                   MethodLU, MethodSVD, MethodTrsm, Norm, NormScope,
+                   NumericalError, Op, Options, Side, SingularMatrixError,
+                   SlateError, SymmetricMatrix, Target, TileKind,
+                   TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
                    Uplo, func)
 
 from .blas import (add, col_norms, copy, gemm, gemmA, gemmC, hemm, hemmA,
@@ -40,6 +42,9 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
                      sytrs, tb2bd, tbsm, tbsm_pivots, tbsmPivots, trcondest,
                      trtri, trtrm, unmbr_ge2tb,
                      unmbr_tb2bd, unmlq, unmqr, unmtr_hb2st, unmtr_he2hb)
+from . import robust
+from .robust import (FaultPlan, FaultSpec, RetryPolicy, SolveReport,
+                     reduce_info)
 from . import simplified
 from . import matgen
 from . import native

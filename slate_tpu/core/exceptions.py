@@ -1,7 +1,19 @@
 """Exceptions (reference: include/slate/Exception.hh:1-126).
 
 The reference wraps MPI errors (`internal/mpi.hh:10-37`); here there is no MPI — JAX/XLA
-errors propagate natively — so only the library-level exception and assert helper remain.
+errors propagate natively — so the library-level exception hierarchy and the assert
+helper remain.  The taxonomy below mirrors the *failure classes* the reference's
+drivers distinguish through info codes and fallback paths (SURVEY §2.7):
+
+- :class:`NumericalError` — the factorization/solve ran but the numbers broke
+  (non-finite values, loss of positive definiteness, breakdown pivots).
+- :class:`SingularMatrixError` — a zero/NaN pivot made the matrix numerically
+  singular (LAPACK info > 0 from LU/Cholesky-class factorizations).
+- :class:`ConvergenceError` — an iterative stage (IR, GMRES-IR, eigensolver
+  iteration) stalled and every declared escalation rung was exhausted.
+  Raised by ``slate_tpu.robust.run_ladder`` when the caller asks for it
+  (``raise_on_exhaust=True``); the built-in drivers keep LAPACK semantics
+  instead — best-effort result, nonzero info, ``recovered=False`` report.
 """
 
 from __future__ import annotations
@@ -9,6 +21,39 @@ from __future__ import annotations
 
 class SlateError(RuntimeError):
     """Library error (reference slate_error / SLATE Exception.hh:1-60)."""
+
+
+class NumericalError(SlateError):
+    """A computation produced numerically invalid results.
+
+    Covers non-finite values, indefinite matrices where SPD was required,
+    and breakdown pivots."""
+
+
+class SingularMatrixError(NumericalError):
+    """The matrix is numerically singular (zero/NaN pivot; LAPACK info > 0).
+
+    ``info`` carries the 1-based index of the first failing pivot when known.
+    """
+
+    def __init__(self, msg: str = "", info: int = 0):
+        super().__init__(msg or f"singular matrix (info={info})")
+        self.info = int(info)
+
+
+class ConvergenceError(NumericalError):
+    """An iterative solve failed to converge and no fallback recovered it.
+
+    Raised by ``robust.run_ladder(..., raise_on_exhaust=True)``; the built-in
+    drivers return best-effort + nonzero info instead of raising (LAPACK
+    convention), so catch this only around ladders you run with that flag.
+    ``report`` (when set) is the :class:`slate_tpu.robust.SolveReport` of the
+    exhausted escalation ladder.
+    """
+
+    def __init__(self, msg: str = "", report=None):
+        super().__init__(msg or "iterative solve failed to converge")
+        self.report = report
 
 
 def slate_assert(cond: bool, msg: str = "") -> None:

@@ -284,6 +284,13 @@ class Options:
     precision: Optional[Any] = None   # compute dtype override (e.g. jnp.bfloat16)
     factor_precision: Optional[Any] = None  # low precision for *_mixed factor step
     exact_info: bool = False          # host-refine LAPACK info indices (syncs!)
+    # resilience knobs (slate_tpu.robust; no reference analogue — the
+    # reference's UseFallbackSolver is the only health option it exposes)
+    solve_report: bool = False        # append a robust.SolveReport to solver
+                                      # returns (opt-in structured health)
+    max_retries: int = 0              # host-level same-rung retries before a
+                                      # ladder escalates (robust.RetryPolicy)
+    retry_backoff: float = 0.0        # seconds between host-level retries
     f64_emulation: bool = False       # gemm via exact Ozaki bf16 splitting —
                                       # true double-precision results on f64-
                                       # less TPUs at ~s(s+1)/2 bf16-gemm cost

@@ -362,8 +362,10 @@ def pbtrf(A, opts=None, uplo=None, kd=None):
     with trace_block("pbtrf", n=n, kd=kd_v):
         L = _pbtrf_fn(n, kd_v, nb, str(a.dtype))(a)
     diag = jnp.real(jnp.diagonal(L, axis1=-2, axis2=-1))
-    bad = ~(jnp.isfinite(diag) & (diag > 0))
-    info = jnp.where(bad.any(), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+    # shared info kernel (robust.first_bad_index, reduce_info semantics)
+    from ..robust import first_bad_index
+
+    info = first_bad_index(~(jnp.isfinite(diag) & (diag > 0)))
     return write_back(A, L), info
 
 

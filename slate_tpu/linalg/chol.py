@@ -40,7 +40,9 @@ from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_arra
                            distribution_grid, write_back)
 from ..core.types import Options, Target, Uplo
 from ..ops import blas3
-from ..utils.trace import trace_block
+from ..robust import (RetryPolicy, Rung, SolveReport, first_bad_index, inject,
+                      run_ladder)
+from ..utils.trace import trace_block, trace_event
 
 
 def _full_spd(A, uplo) -> jax.Array:
@@ -66,12 +68,10 @@ def _full_spd(A, uplo) -> jax.Array:
 
 def _chol_info(L) -> jax.Array:
     """LAPACK-style info from a lower factor: 0 if SPD, else 1-based index of the
-    first non-positive/NaN pivot (reference reduce_info semantics)."""
+    first non-positive/NaN pivot — the shared info kernel
+    (robust.first_bad_index, reference reduce_info semantics)."""
     d = jnp.real(jnp.diagonal(L, axis1=-2, axis2=-1))
-    bad = jnp.isnan(d) | (d <= 0)
-    any_bad = jnp.any(bad)
-    first = jnp.argmax(bad)  # first True (argmax of bool)
-    return jnp.where(any_bad, first + 1, 0).astype(jnp.int32)
+    return first_bad_index(jnp.isnan(d) | (d <= 0))
 
 
 def _host_chol_info(a, nb: int = 256) -> int:
@@ -215,6 +215,7 @@ def potrf(A, opts=None, uplo=None):
     the_uplo = Uplo.from_string(the_uplo)
     Af = _full_spd(A, the_uplo if not isinstance(A, (HermitianMatrix, SymmetricMatrix))
                    else None)
+    Af = inject("potrf", Af)
     n = Af.shape[-1]
     target = opts.target
     if target == Target.Auto:
@@ -269,11 +270,21 @@ def potrs(A, B, opts=None, uplo=None):
 
 
 def posv(A, B, opts=None, uplo=None):
-    """Solve SPD system A X = B (src/posv.cc = potrf + potrs)."""
+    """Solve SPD system A X = B (src/posv.cc = potrf + potrs).
+
+    Returns (X, info); with ``Options(solve_report=True)``,
+    (X, info, SolveReport)."""
+    opts = Options.make(opts)
     L, info = potrf(A, opts, uplo)
     X = potrs(L if not isinstance(A, BaseMatrix) else A, B, opts,
               uplo=uplo or (A.uplo if isinstance(A, BaseMatrix)
                             and A.uplo != Uplo.General else "lower"))
+    if opts.solve_report:
+        report = SolveReport(routine="posv", info=int(info),
+                             precision_used=str(as_array(L).dtype),
+                             fallback_chain=("cholesky",)).finalize()
+        report.recovered = report.info == 0
+        return X, info, report
     return X, info
 
 
@@ -391,38 +402,84 @@ def _ir_solve(Af, b, solve_lo, opts: Options):
 
 def posv_mixed(A, B, opts=None, uplo=None):
     """SPD solve: low-precision factor + working-precision refinement
-    (src/posv_mixed.cc; falls back to full-precision posv when IR stalls,
-    Option::UseFallbackSolver, gesv_mixed.cc:93-96).
+    (src/posv_mixed.cc), run as the declared mixed→full escalation ladder
+    (robust.LADDERS["posv_mixed"]; Option::UseFallbackSolver gates the second
+    rung, gesv_mixed.cc:93-96).
 
-    Returns (X, info, iters).
+    Returns (X, info, iters); with ``Options(solve_report=True)``,
+    (X, info, iters, SolveReport).
     """
     opts = Options.make(opts)
     the_uplo = uplo or (A.uplo if isinstance(A, BaseMatrix) and A.uplo != Uplo.General
                         else Uplo.Lower)
-    Af = _full_spd(A, None if isinstance(A, (HermitianMatrix, SymmetricMatrix))
-                   else the_uplo)
+    Af0 = _full_spd(A, None if isinstance(A, (HermitianMatrix, SymmetricMatrix))
+                    else the_uplo)
+    # pristine snapshot: each rung re-enters the input injection site, so a
+    # call_index=0 input fault is transient under escalation — the full-
+    # precision rung recovers from intact data, never a corrupted copy
     b = as_array(B)
-    lo = opts.factor_precision or _lower_precision(Af.dtype)
+    plain = opts.replace(solve_report=False)
+    lo = opts.factor_precision or _lower_precision(Af0.dtype)
+    report = SolveReport(routine="posv_mixed") if opts.solve_report else None
     if lo is None:
-        X, info = posv(A, B, opts, uplo)
+        Af = inject("posv_mixed", Af0)
+        if Af is Af0 and isinstance(A, BaseMatrix):
+            # no fault fired → original wrapper through posv, keeping its
+            # in-place L-factor write-back (pre-ladder contract)
+            X, info = posv(A, b, plain, uplo)
+        else:
+            X, info = posv(Af, b, plain, "lower")
+        X = write_back(B, as_array(X))
+        if report is not None:
+            report.record_rung("full")
+            report.info, report.precision_used = int(info), str(Af0.dtype)
+            report.recovered = report.info == 0
+            return X, info, jnp.int32(0), report.finalize()
         return X, info, jnp.int32(0)
 
-    with trace_block("posv_mixed", lo=str(lo)):
-        L_lo = lax.linalg.cholesky(Af.astype(lo))
-        info = _chol_info(L_lo)
+    state = {"iters": jnp.int32(0)}
 
-        def solve_lo(rhs):
-            y = lax.linalg.triangular_solve(L_lo, rhs.astype(lo), left_side=True,
-                                            lower=True)
-            return lax.linalg.triangular_solve(L_lo, y, left_side=True, lower=True,
-                                               conjugate_a=True, transpose_a=True)
+    def mixed_rung():
+        Af = inject("posv_mixed", Af0)
+        with trace_block("posv_mixed", lo=str(lo)):
+            L_lo = lax.linalg.cholesky(Af.astype(lo))
+            L_lo = inject("posv_mixed", L_lo, point="factor")
+            info = _chol_info(L_lo)
 
-        x, iters, converged = _ir_solve(Af, b, solve_lo, opts)
+            def solve_lo(rhs):
+                y = lax.linalg.triangular_solve(L_lo, rhs.astype(lo),
+                                                left_side=True, lower=True)
+                return lax.linalg.triangular_solve(L_lo, y, left_side=True,
+                                                   lower=True, conjugate_a=True,
+                                                   transpose_a=True)
 
-    if opts.use_fallback_solver and not bool(converged):
-        X, info = posv(A, B, opts, uplo)   # full-precision fallback
-        return X, info, iters
-    return write_back(B, x), info, iters
+            x, iters, converged = _ir_solve(Af, b, solve_lo, opts)
+        state["iters"] = iters
+        return (x, info), bool(converged)
+
+    def full_rung():
+        Af = inject("posv_mixed", Af0)
+        if Af is Af0 and isinstance(A, BaseMatrix):
+            # no fault fired → original wrapper through posv, preserving its
+            # in-place L-factor write-back (the mixed rung never touched it)
+            X, info = posv(A, b, plain, uplo)
+        else:
+            X, info = posv(Af, b, plain, "lower")   # full-precision fallback
+        return (as_array(X), info), bool(info == 0)
+
+    rungs = [Rung("mixed", mixed_rung)]
+    if opts.use_fallback_solver:
+        rungs.append(Rung("full", full_rung))
+    x, info = run_ladder("posv_mixed", rungs,
+                         RetryPolicy.from_options(opts, "posv_mixed"), report)
+    X = write_back(B, x)
+    if report is not None:
+        report.info = int(info)
+        report.iters = int(state["iters"])
+        report.precision_used = (str(jnp.dtype(lo)) if report.fallback_chain
+                                 == ("mixed",) else str(Af0.dtype))
+        return X, info, state["iters"], report.finalize()
+    return X, info, state["iters"]
 
 
 def posv_mixed_gmres(A, B, opts=None, uplo=None):
@@ -440,7 +497,9 @@ def posv_mixed_gmres(A, B, opts=None, uplo=None):
     _require_single_rhs(b, "posv_mixed_gmres")
     lo = opts.factor_precision or _lower_precision(Af.dtype)
     if lo is None:
-        X, info = posv(A, B, opts, uplo)
+        # solve_report stays off here: posv would otherwise append a report
+        # and break this 2-way unpack (posv_mixed_gmres has no report form)
+        X, info = posv(A, B, opts.replace(solve_report=False), uplo)
         return X, info, jnp.int32(0)
 
     with trace_block("posv_mixed_gmres", lo=str(lo)):
@@ -461,6 +520,9 @@ def posv_mixed_gmres(A, B, opts=None, uplo=None):
                                                "posv_mixed_gmres")
 
     if opts.use_fallback_solver and not converged:
-        X, info = posv(A, B, opts, uplo)
+        # mixed_gmres→full ladder (robust.LADDERS), open-coded like
+        # gesv_mixed_gmres; the event keeps the escalation traceable
+        trace_event("fallback", routine="posv_mixed_gmres", to="full")
+        X, info = posv(A, B, opts.replace(solve_report=False), uplo)
         return X, info, jnp.int32(-1)
     return write_back(B, x_out), info, jnp.int32(restarts)

@@ -33,11 +33,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.exceptions import SlateError, slate_assert
+from ..core.exceptions import NumericalError, SlateError, slate_assert
 from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_array,
                            distribution_grid, write_back)
 from ..core.types import MethodEig, Norm, Options, Target, Uplo
 from ..ops import norms as norm_ops
+from ..robust import inject
 from ..utils.trace import Timers, trace_block
 from .chol import _full_spd, potrf
 
@@ -78,7 +79,7 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
     """
     opts = Options.make(opts)
     timers = Timers()
-    a = _full_herm(A, uplo)
+    a = inject("heev", _full_herm(A, uplo))
     n = a.shape[-1]
     grid = distribution_grid(A)
     if grid is not None:
@@ -179,6 +180,17 @@ def heev_range(A, opts=None, uplo=None, *, il: int = 0,
         iu = n
     slate_assert(0 <= il < iu <= n,
                  f"index range [{il}, {iu}) invalid for n={n}")
+    grid = distribution_grid(A)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: route to the distributed subset
+        # pipeline like heev does (sharded stage 1, thin back-transforms) —
+        # previously this silently gathered the whole matrix to one device
+        from ..parallel import heev_range_distributed
+
+        lam, z = heev_range_distributed(
+            a, grid, il, iu, nb=default_band_nb(n, opts),
+            want_vectors=want_vectors, chase_pipeline=chase_pipeline)
+        return (lam, z) if want_vectors else (lam, None)
     if n < 8:
         lam, z = jnp.linalg.eigh(a)
         return (lam[il:iu], z[:, il:iu]) if want_vectors \
@@ -220,6 +232,11 @@ def eig_count(A, vl, vu, opts=None, uplo=None):
     Endpoints coinciding with an eigenvalue are eps-sensitive (the Sturm
     count is strictly-below) — pick endpoints in spectral gaps."""
     opts = Options.make(opts)
+    slate_assert(distribution_grid(A) is None,
+                 "eig_count has no distributed pipeline: the Sturm-count "
+                 "stage is replicated-only.  Gather the wrapper to a plain "
+                 "array explicitly (eig_count(A.array, ...)) to accept the "
+                 "single-device cost, or use heev_range for subset spectra.")
     a = _full_herm(A, uplo)
     n = a.shape[-1]
     if n < 8:
@@ -264,7 +281,7 @@ def _hegv_pipeline(itype: int, A, B, opts, uplo, want_vectors, solve,
     with trace_block(label, n=b.shape[-1]):
         L, info = potrf(b, opts)
         if int(info) != 0:
-            raise SlateError(
+            raise NumericalError(
                 f"{label}: B not positive definite (info={int(info)})")
         C = hegst(itype, A, L, opts, uplo)
         lam, z = solve(C)

@@ -33,6 +33,7 @@ from jax import lax
 
 from ..core.matrix import as_array, write_back
 from ..core.types import Options
+from ..robust import inject
 from ..utils.trace import trace_block
 from .band import BandLU, gbtrf, gbtrs
 from .eig import _full_herm
@@ -138,7 +139,7 @@ def hetrf(A, opts=None, uplo=None):
     """Aasen factorization P A P^H = L T L^H with band T (src/hetrf.cc).
     Returns (HermitianFactors, info)."""
     opts = Options.make(opts)
-    a = _full_herm(A, uplo)
+    a = inject("hetrf", _full_herm(A, uplo))
     n = a.shape[-1]
     nb = min(opts.block_size, n)
     with trace_block("hetrf", n=n, nb=nb):
@@ -173,22 +174,32 @@ def hetrs(fac: HermitianFactors, B, opts=None):
 
 def hesv(A, B, opts=None, uplo=None):
     """Solve a Hermitian-indefinite system (src/hesv.cc): hetrf + hetrs.
-    Returns (X, info)."""
+    Returns (X, info); with ``Options(solve_report=True)``,
+    (X, info, SolveReport) — on both the single-device and grid paths."""
     from ..core.matrix import distribution_grid
 
+    opts_ = Options.make(opts)
     grid = distribution_grid(A, B)
     if grid is not None:
         # wrapper bound to a >1-device grid: distributed CA-Aasen
         # (hesv.cc consumes the construction-time distribution the same way)
         from ..parallel import hesv_distributed
 
-        opts_ = Options.make(opts)
         a = _full_herm(A, uplo)
         x, info = hesv_distributed(a, as_array(B), grid,
                                    nb=min(opts_.block_size, a.shape[-1]))
-        return write_back(B, x), info
-    fac, info = hetrf(A, opts, uplo)
-    x = hetrs(fac, B, opts)
+        x = write_back(B, x)
+    else:
+        fac, info = hetrf(A, opts, uplo)
+        x = hetrs(fac, B, opts)
+    if opts_.solve_report:
+        from ..robust import SolveReport
+
+        report = SolveReport(routine="hesv", info=int(info),
+                             precision_used=str(as_array(x).dtype),
+                             fallback_chain=("aasen",)).finalize()
+        report.recovered = report.info == 0
+        return x, info, report
     return x, info
 
 

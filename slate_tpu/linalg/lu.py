@@ -42,7 +42,9 @@ from jax import lax
 from ..core.exceptions import SlateError, slate_assert
 from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodLU, Options, Target
-from ..utils.trace import trace_block
+from ..robust import (RetryPolicy, Rung, SolveReport, first_bad_index, inject,
+                      run_ladder)
+from ..utils.trace import trace_block, trace_event
 from .chol import _ir_solve
 
 
@@ -92,8 +94,9 @@ def _compose_perm(outer, inner):
 
 
 def _lu_info(U_diag) -> jax.Array:
-    bad = jnp.isnan(U_diag) | (U_diag == 0)
-    return jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+    """First zero/NaN U pivot, LAPACK-style — the shared info kernel
+    (robust.first_bad_index, the reference's reduce_info semantics)."""
+    return first_bad_index(jnp.isnan(U_diag) | (U_diag == 0))
 
 
 # ---------------------------------------------------------------------------
@@ -173,7 +176,7 @@ def _getrf_nopiv_fn(m: int, n: int, nb: int, dtype_str: str):
 def getrf_nopiv(A, opts=None):
     """LU without pivoting (src/getrf_nopiv.cc). Returns (LU, info)."""
     opts = Options.make(opts)
-    a = as_array(A)
+    a = inject("getrf_nopiv", as_array(A))
     m, n = a.shape[-2:]
     with trace_block("getrf_nopiv", m=m, n=n):
         out = _getrf_nopiv_fn(m, n, min(opts.block_size, m, n), str(a.dtype))(a)
@@ -247,7 +250,7 @@ def getrf(A, opts=None):
         raise SlateError(f"unsupported MethodLU {method}")
 
     grid = distribution_grid(A)
-    a_chk = as_array(A)
+    a_chk = inject("getrf", as_array(A))
     if grid is not None:
         # wrapper bound to a >1-device grid: tournament-pivoted distributed LU
         # (the mesh form of getrf_tntpiv; reference getrf.cc consumes the
@@ -261,7 +264,7 @@ def getrf(A, opts=None):
         write_back(A, lu_)
         return lu_, perm, info
 
-    a = as_array(A)
+    a = a_chk
     m, n = a.shape[-2:]
     target = opts.target
     if target == Target.Auto:
@@ -436,7 +439,7 @@ def getrf_tntpiv(A, opts=None):
     """Tournament-pivoted (CALU) LU (src/getrf_tntpiv.cc:161-230).
     Returns (LU, perm, info)."""
     opts = Options.make(opts)
-    a = as_array(A)
+    a = inject("getrf_tntpiv", as_array(A))
     m, n = a.shape[-2:]
     nb = min(opts.block_size, m, n)
     ib = max(1, min(opts.inner_blocking, nb))
@@ -493,16 +496,80 @@ def getrs_nopiv(LU, B, opts=None, trans=False):
 
 
 def gesv(A, B, opts=None):
-    """Solve A X = B (src/gesv.cc = getrf + getrs). Returns (X, perm, info)."""
-    lu_, perm, info = getrf(A, opts)
+    """Solve A X = B (src/gesv.cc = getrf + getrs).
+
+    Returns (X, perm, info); with ``Options(solve_report=True)``,
+    (X, perm, info, SolveReport)."""
+    opts = Options.make(opts)
+    lu_, perm, info = getrf(A, opts if not opts.solve_report
+                            else opts.replace(solve_report=False))
     X = getrs(lu_, perm, B, opts)
+    if opts.solve_report:
+        report = SolveReport(routine="gesv", info=int(info),
+                             precision_used=str(as_array(lu_).dtype),
+                             fallback_chain=(str(opts.method_lu),)).finalize()
+        report.recovered = report.info == 0
+        return X, perm, info, report
     return X, perm, info
 
 
 def gesv_nopiv(A, B, opts=None):
-    """src/gesv_nopiv.cc."""
-    opts = Options.make(opts).replace(method_lu="nopiv")
-    return gesv(A, B, opts)
+    """Solve A X = B without pivoting, escalating to partial pivoting on breakdown.
+
+    The declared ladder (src/gesv_nopiv.cc +
+    robust.LADDERS["gesv_nopiv"]): a nopiv breakdown (zero pivot, info > 0,
+    or non-finite X) re-solves with partial pivoting from the *pristine*
+    operand when Option::UseFallbackSolver holds — the recovery the reference
+    leaves to the caller.  Detecting the breakdown costs one host sync (a
+    fused info+isfinite verdict, trivial next to the O(n³) factor); pipelined
+    callers who want the old zero-sync alias pass
+    ``Options(use_fallback_solver=False)``.  Returns (X, perm, info); with
+    ``Options(solve_report=True)``, (X, perm, info, SolveReport)."""
+    opts = Options.make(opts)
+    base = opts.replace(method_lu="nopiv", solve_report=False)
+    from ..robust import active
+
+    if (not opts.use_fallback_solver and not opts.solve_report
+            and opts.max_retries <= 0 and active() is None):
+        # single-rung ladder with nothing to observe it: the ok verdict could
+        # never trigger an escalation, so skip the ladder machinery and its
+        # host sync + isfinite reduction — the original zero-sync alias
+        return gesv(A, B, base)
+    a0, b0 = as_array(A), as_array(B)   # immutable snapshots: rungs re-solve
+    #                                     from intact inputs, never a half-
+    #                                     written factor
+    report = SolveReport(routine="gesv_nopiv") if opts.solve_report else None
+    policy = RetryPolicy.from_options(opts, "gesv_nopiv")
+
+    def _operand():
+        # a Matrix wrapper keeps its in-place factor write-back: restore the
+        # pristine operand first (a prior rung left ITS factor in the
+        # wrapper), then let gesv factor the wrapper itself.  Plain arrays
+        # just use the snapshot.
+        if isinstance(A, BaseMatrix):
+            write_back(A, a0)
+            return A
+        return a0
+
+    def nopiv_rung():
+        out = gesv(_operand(), b0, base)
+        ok = bool((out[2] == 0) & jnp.all(jnp.isfinite(as_array(out[0]))))
+        return out, ok
+
+    def pp_rung():
+        out = gesv(_operand(), b0, base.replace(method_lu="partialpiv"))
+        return out, bool(out[2] == 0)
+
+    rungs = [Rung("nopiv", nopiv_rung)]
+    if opts.use_fallback_solver:
+        rungs.append(Rung("partialpiv", pp_rung))
+    X, perm, info = run_ladder("gesv_nopiv", rungs, policy, report)
+    X = write_back(B, as_array(X))
+    if report is not None:
+        report.info = int(info)
+        report.precision_used = str(a0.dtype)
+        return X, perm, info, report.finalize()
+    return X, perm, info
 
 
 def getri(LU, perm, opts=None):
@@ -531,30 +598,73 @@ def getri_oop(LU, perm, B, opts=None):
 
 def gesv_mixed(A, B, opts=None):
     """Low-precision LU factor + working-precision iterative refinement
-    (src/gesv_mixed.cc:23-40,106+). Returns (X, perm, info, iters)."""
+    (src/gesv_mixed.cc:23-40,106+), run as the declared mixed→full escalation
+    ladder (robust.LADDERS["gesv_mixed"]; Option::UseFallbackSolver gates the
+    second rung, gesv_mixed.cc:93-96).  Returns (X, perm, info, iters); with
+    ``Options(solve_report=True)``, (..., SolveReport)."""
     from .chol import _lower_precision
 
     opts = Options.make(opts)
-    a = as_array(A)
+    a0 = as_array(A)        # pristine snapshot: each rung re-enters the input
+    #                         injection site, so a call_index=0 input fault is
+    #                         transient under escalation (the ladder recovers
+    #                         from intact data, never a corrupted copy)
     b = as_array(B)
-    lo = opts.factor_precision or _lower_precision(a.dtype)
+    plain = opts.replace(solve_report=False)
+    lo = opts.factor_precision or _lower_precision(a0.dtype)
+    report = SolveReport(routine="gesv_mixed") if opts.solve_report else None
     if lo is None:
-        X, perm, info = gesv(A, B, opts)
+        a_in = inject("gesv_mixed", a0)
+        # no fault fired → pass the original operand through, so a Matrix
+        # wrapper keeps its in-place factor write-back (pre-ladder contract)
+        src = A if (a_in is a0 and isinstance(A, BaseMatrix)) else a_in
+        X, perm, info = gesv(src, b, plain)
+        X = write_back(B, as_array(X))
+        if report is not None:
+            report.record_rung("full")
+            report.info, report.precision_used = int(info), str(a0.dtype)
+            report.recovered = report.info == 0
+            return X, perm, info, jnp.int32(0), report.finalize()
         return X, perm, info, jnp.int32(0)
 
-    with trace_block("gesv_mixed", lo=str(lo)):
-        plu, _, perm = lax.linalg.lu(a.astype(lo))
-        info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
+    state = {"iters": jnp.int32(0)}
 
-        def solve_lo(rhs):
-            return lu_factored_solve(plu, perm, rhs.astype(lo))
+    def mixed_rung():
+        a = inject("gesv_mixed", a0)
+        with trace_block("gesv_mixed", lo=str(lo)):
+            plu, _, perm = lax.linalg.lu(a.astype(lo))
+            plu = inject("gesv_mixed", plu, point="factor")
+            info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
 
-        x, iters, converged = _ir_solve(a, b, solve_lo, opts)
+            def solve_lo(rhs):
+                return lu_factored_solve(plu, perm, rhs.astype(lo))
 
-    if opts.use_fallback_solver and not bool(converged):
-        X, perm, info = gesv(A, B, opts)
-        return X, perm, info, iters
-    return write_back(B, x), perm, info, iters
+            x, iters, converged = _ir_solve(a, b, solve_lo, opts)
+        state["iters"] = iters
+        return (x, perm, info), bool(converged)
+
+    def full_rung():
+        a_in = inject("gesv_mixed", a0)
+        # no fault fired → original wrapper through, preserving its in-place
+        # factor write-back (the mixed rung never touched its storage)
+        src = A if (a_in is a0 and isinstance(A, BaseMatrix)) else a_in
+        X, perm, info = gesv(src, b, plain)
+        return (as_array(X), perm, info), bool(info == 0)
+
+    rungs = [Rung("mixed", mixed_rung)]
+    if opts.use_fallback_solver:
+        rungs.append(Rung("full", full_rung))
+    x, perm, info = run_ladder("gesv_mixed", rungs,
+                               RetryPolicy.from_options(opts, "gesv_mixed"),
+                               report)
+    X = write_back(B, x)
+    if report is not None:
+        report.info = int(info)
+        report.iters = int(state["iters"])
+        report.precision_used = (str(jnp.dtype(lo)) if report.fallback_chain
+                                 == ("mixed",) else str(a0.dtype))
+        return X, perm, info, state["iters"], report.finalize()
+    return X, perm, info, state["iters"]
 
 
 def _fgmres(matvec, precond, b, x0, restart, tol, max_restarts):
@@ -648,7 +758,9 @@ def gesv_mixed_gmres(A, B, opts=None):
     _require_single_rhs(b, "gesv_mixed_gmres")
     lo = opts.factor_precision or _lower_precision(a.dtype)
     if lo is None:
-        X, perm, info = gesv(A, B, opts)
+        # solve_report stays off here: gesv would otherwise append a report
+        # and break this 3-way unpack (gesv_mixed_gmres has no report form)
+        X, perm, info = gesv(A, B, opts.replace(solve_report=False))
         return X, perm, info, jnp.int32(0)
 
     with trace_block("gesv_mixed_gmres", lo=str(lo)):
@@ -666,7 +778,11 @@ def gesv_mixed_gmres(A, B, opts=None):
                                                "gesv_mixed_gmres")
 
     if opts.use_fallback_solver and not converged:
-        X, perm, info = gesv(A, B, opts)
+        # mixed_gmres→full ladder (robust.LADDERS) — open-coded because the
+        # GMRES machinery already returned its verdict; event keeps the
+        # escalation visible in the chrome trace
+        trace_event("fallback", routine="gesv_mixed_gmres", to="full")
+        X, perm, info = gesv(A, B, opts.replace(solve_report=False))
         return X, perm, info, jnp.int32(-1)
     return write_back(B, x_out), perm, info, jnp.int32(restarts)
 
@@ -728,9 +844,16 @@ def gerbt(Wu, Wv, A):
 
 def gesv_rbt(A, B, opts=None, key=None):
     """Solve via random butterfly transform + nopiv LU + refinement
-    (src/gesv_rbt.cc:94-172). Returns (X, info, iters)."""
+    (src/gesv_rbt.cc:94-172), run as the declared RBT→partial-pivot
+    escalation ladder (robust.LADDERS["gesv_rbt"]): when the butterfly fails
+    to tame the matrix (nopiv breakdown or IR stall) the pivoted solve takes
+    over from the pristine operand.  Returns (X, info, iters); with
+    ``Options(solve_report=True)``, (X, info, iters, SolveReport)."""
     opts = Options.make(opts)
-    a = as_array(A)
+    a0 = as_array(A)        # pristine snapshot: each rung re-enters the input
+    #                         injection site (transient-fault contract; the
+    #                         pivoted escalation really does take over from
+    #                         intact data, as the docstring promises)
     b = as_array(B)
     grid = distribution_grid(A)
     if grid is not None:
@@ -738,41 +861,77 @@ def gesv_rbt(A, B, opts=None, key=None):
         # (parallel/rbt.py), like every other driver's grid dispatch
         from ..parallel.rbt import gesv_rbt_distributed
 
-        X, info, iters = gesv_rbt_distributed(
-            a, b, grid, depth=opts.depth,
-            nb=min(opts.block_size, a.shape[-1]), key=key,
+        X, info, iters, via_rbt = gesv_rbt_distributed(
+            inject("gesv_rbt", a0), b, grid, depth=opts.depth,
+            nb=min(opts.block_size, a0.shape[-1]), key=key,
             max_iterations=opts.max_iterations,
             use_fallback=opts.use_fallback_solver, tol=opts.tolerance)
-        return write_back(B, X), info, iters
-    n = a.shape[-1]
+        X = write_back(B, X)
+        if opts.solve_report:
+            chain = ("rbt",) if via_rbt else ("rbt", "partialpiv")
+            report = SolveReport(routine="gesv_rbt", info=int(info),
+                                 iters=int(iters),
+                                 precision_used=str(a0.dtype),
+                                 fallback_chain=chain).finalize()
+            report.recovered = report.info == 0 and (
+                via_rbt or opts.use_fallback_solver)
+            return X, info, iters, report
+        return X, info, iters
+    n = a0.shape[-1]
     depth = opts.depth
     # pad n to a multiple of 2^depth for the butterfly recursion
     pad = (-n) % (2 ** depth)
     key = key if key is not None else jax.random.PRNGKey(42)
     ku, kv = jax.random.split(key)
     np_ = n + pad
-    Wu = rbt_generate(ku, np_, depth, a.dtype)
-    Wv = rbt_generate(kv, np_, depth, a.dtype)
-    ap = jnp.pad(a, ((0, pad), (0, pad)))
-    if pad:
-        ap = ap.at[jnp.arange(n, np_), jnp.arange(n, np_)].set(1)
-    with trace_block("gesv_rbt", n=n, depth=depth):
-        at = _butterfly_apply(Wu, ap, transpose=True)
-        at = _butterfly_apply(Wv, at.T, transpose=True).T
-        lu_p, info = getrf_nopiv(at, opts)
+    plain = opts.replace(solve_report=False)
+    report = SolveReport(routine="gesv_rbt") if opts.solve_report else None
+    state = {"iters": jnp.int32(0)}
 
-        def solve_rbt(rhs):
-            rp = jnp.pad(rhs, ((0, pad),) + ((0, 0),) * (rhs.ndim - 1))
-            y = _butterfly_apply(Wu, rp, transpose=True)
-            z = lax.linalg.triangular_solve(lu_p, y, left_side=True, lower=True,
-                                            unit_diagonal=True)
-            w = lax.linalg.triangular_solve(lu_p, z, left_side=True, lower=False)
-            x = _butterfly_apply(Wv, w, transpose=False)
-            return x[:n]
+    def rbt_rung():
+        a = inject("gesv_rbt", a0)
+        Wu = rbt_generate(ku, np_, depth, a.dtype)
+        Wv = rbt_generate(kv, np_, depth, a.dtype)
+        ap = jnp.pad(a, ((0, pad), (0, pad)))
+        if pad:
+            ap = ap.at[jnp.arange(n, np_), jnp.arange(n, np_)].set(1)
+        with trace_block("gesv_rbt", n=n, depth=depth):
+            at = _butterfly_apply(Wu, ap, transpose=True)
+            at = _butterfly_apply(Wv, at.T, transpose=True).T
+            lu_p, info = getrf_nopiv(at, plain)
+            lu_p = inject("gesv_rbt", lu_p, point="factor")
 
-        x, iters, converged = _ir_solve(a, b, solve_rbt, opts)
+            def solve_rbt(rhs):
+                rp = jnp.pad(rhs, ((0, pad),) + ((0, 0),) * (rhs.ndim - 1))
+                y = _butterfly_apply(Wu, rp, transpose=True)
+                z = lax.linalg.triangular_solve(lu_p, y, left_side=True,
+                                                lower=True, unit_diagonal=True)
+                w = lax.linalg.triangular_solve(lu_p, z, left_side=True,
+                                                lower=False)
+                x = _butterfly_apply(Wv, w, transpose=False)
+                return x[:n]
 
-    if opts.use_fallback_solver and not bool(converged):
-        X, _, info = gesv(A, B, opts)
-        return X, info, iters
-    return write_back(B, x), info, iters
+            x, iters, converged = _ir_solve(a, b, solve_rbt, opts)
+        state["iters"] = iters
+        return (x, info), bool(converged)
+
+    def pp_rung():
+        a_in = inject("gesv_rbt", a0)
+        # no fault fired → original wrapper through, preserving its in-place
+        # factor write-back (the rbt rung factors a transformed copy only)
+        src = A if (a_in is a0 and isinstance(A, BaseMatrix)) else a_in
+        X, _, info = gesv(src, b, plain)
+        return (as_array(X), info), bool(info == 0)
+
+    rungs = [Rung("rbt", rbt_rung)]
+    if opts.use_fallback_solver:
+        rungs.append(Rung("partialpiv", pp_rung))
+    x, info = run_ladder("gesv_rbt", rungs,
+                         RetryPolicy.from_options(opts, "gesv_rbt"), report)
+    X = write_back(B, x)
+    if report is not None:
+        report.info = int(info)
+        report.iters = int(state["iters"])
+        report.precision_used = str(a0.dtype)
+        return X, info, state["iters"], report.finalize()
+    return X, info, state["iters"]

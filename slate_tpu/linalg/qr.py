@@ -40,6 +40,7 @@ from jax import lax
 from ..core.exceptions import SlateError
 from ..core.matrix import BaseMatrix, as_array, distribution_grid, write_back
 from ..core.types import MethodGels, Op, Options, Side
+from ..robust import inject
 from ..utils.trace import trace_block
 from ..ops.blas3 import gram
 from .chol import _chol_blocked, _chol_info
@@ -104,7 +105,7 @@ def geqrf(A, opts=None):
     """QR factorization A = Q R (src/geqrf.cc). Returns TriangularFactors; writes the
     packed factor back into a Matrix wrapper (R in the upper triangle, V below)."""
     opts = Options.make(opts)
-    a = as_array(A)
+    a = inject("geqrf", as_array(A))
     m, n = a.shape[-2:]
     k = min(m, n)
     with trace_block("geqrf", m=m, n=n):
@@ -218,9 +219,14 @@ def tsqr(a, row_blocks: int = 0, nb: int = 1024):
 def cholqr(A, opts=None):
     """Cholesky QR (src/cholqr.cc): R = chol(A^H A)^H upper, Q = A R^{-1}, with a
     CholeskyQR2 second pass for orthogonality and a shifted retry if the Gram matrix
-    is numerically indefinite. Returns (Q, R)."""
+    is numerically indefinite. Returns (Q, R).
+
+    The cholqr→shifted→Householder escalation is an IN-TRACE ladder
+    (``lax.cond`` chain, declared in robust.LADDERS["cholqr"]): hoisting it
+    to the host would cost a sync per call, so unlike the mixed-precision
+    ladders it stays inside the jitted program."""
     opts = Options.make(opts)
-    a = as_array(A)
+    a = inject("cholqr", as_array(A))
     m, n = a.shape[-2:]
 
     def q_from_chol(L, x):

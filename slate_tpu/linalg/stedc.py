@@ -132,7 +132,9 @@ def _deflate(d_sorted, z_sorted, rho):
     # minimal spacing (equal-diagonal deflation as perturbation)
     gap_min = 8 * eps * scale
     ar = jnp.arange(m, dtype=dt)
-    d = jnp.maximum.accumulate(d_sorted - gap_min * ar) + gap_min * ar
+    # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate method
+    # only exists on newer jax; cummax is the same scan on every version
+    d = lax.cummax(d_sorted - gap_min * ar, axis=0) + gap_min * ar
     # z-floor deflation: LAPACK drops tiny-z entries from the secular problem;
     # with static shapes we instead *floor* z^2 so every bracket keeps a pole
     # on each side and a strictly interior root.  Strict interlacing is what

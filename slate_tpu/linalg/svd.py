@@ -23,6 +23,7 @@ from jax import lax
 from ..core.exceptions import slate_assert
 from ..core.matrix import BaseMatrix, as_array
 from ..core.types import MethodSVD, Options
+from ..robust import inject
 from ..utils.trace import Timers, trace_block
 from .eig import _safe_scale
 from .qr import geqrf, unmqr
@@ -43,7 +44,7 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
     """
     opts = Options.make(opts)
     timers = Timers()
-    a = as_array(A)
+    a = inject("svd", as_array(A))
     m, n = a.shape[-2:]
     want_vectors = want_u or want_vt
     if opts.method_svd == MethodSVD.Bisection and method == "fused":
@@ -187,6 +188,21 @@ def svd_range(A, opts=None, *, il: int = 0, iu: Optional[int] = None,
     opts = Options.make(opts)
     a = as_array(A)
     m, n = a.shape[-2:]
+    from ..core.matrix import distribution_grid
+
+    grid = distribution_grid(A)
+    if grid is not None:
+        # wrapper bound to a >1-device grid: route to the distributed subset
+        # pipeline like svd does (sharded ge2tb, thin back-transforms) —
+        # previously this silently gathered the whole matrix to one device
+        from .eig import default_band_nb
+        from ..parallel import svd_range_distributed
+
+        kmin = min(m, n)
+        return svd_range_distributed(
+            a, grid, il, kmin if iu is None else iu,
+            nb=default_band_nb(kmin, opts), want_vectors=want_vectors,
+            chase_pipeline=chase_pipeline)
     if m < n:
         S, V, UT = svd_range(jnp.conj(a).T, opts, il=il, iu=iu,
                              want_vectors=want_vectors,

@@ -72,6 +72,14 @@ def hemm(side, alpha, A, uplo, B, beta, C):
     return _c(alpha, prod) * prod + _c(beta, C) * C
 
 
+def _real_diag(G):
+    """Zero the imaginary residue on the diagonal of a complex Gram/Hermitian
+    product (the diagonal is mathematically real: sum |x|^2)."""
+    idx = jnp.arange(G.shape[-1])
+    return G.at[..., idx, idx].set(
+        jnp.real(jnp.diagonal(G, axis1=-2, axis2=-1)).astype(G.dtype))
+
+
 def _rank_k_update(update, beta, C, uplo: Uplo, real_diag: bool):
     """Apply a rank-k update to the stored triangle only, leaving the other triangle of
     the backing array untouched (the reference updates only local tiles of the stored
@@ -106,9 +114,10 @@ def gram(x, strips: int = 8, precision=None):
     full Hermitian result — flop factor (1 + 1/S)/2 of the naive square
     matmul (the herk halving; reference internal_herk's triangle scope).
     Each strip product keeps the full contraction dim, so MXU utilization
-    stays gemm-class; the mirror assembly is O(n^2) copies.  The result is
-    exactly Hermitian by construction (the naive matmul is only
-    approximately so in floating point)."""
+    stays gemm-class; the mirror assembly is O(n^2) copies.  The mirror makes
+    the off-diagonal exactly Hermitian by construction; the diagonal needs
+    its imaginary residue forced to zero for complex inputs (the naive
+    matmul leaves rounding residue in both)."""
     if precision is None:
         precision = lax.Precision.HIGHEST
     n = x.shape[-1]
@@ -117,13 +126,16 @@ def gram(x, strips: int = 8, precision=None):
     # lane-aligned; S=1 degenerates to the plain full product
     S = max(1, min(strips, n // 128))
     if S <= 1:
-        return jnp.matmul(xh, x, precision=precision)
+        G = jnp.matmul(xh, x, precision=precision)
+        return _real_diag(G) if jnp.iscomplexobj(G) else G
     G = jnp.zeros(x.shape[:-2] + (n, n), dtype=x.dtype)
     for i in range(S):
         j0, j1 = (i * n) // S, ((i + 1) * n) // S
         blk = jnp.matmul(xh[..., j0:, :], x[..., :, j0:j1],
                          precision=precision)
         G = G.at[..., j0:, j0:j1].set(blk)
+    if jnp.iscomplexobj(G):
+        G = _real_diag(G)
     low = jnp.tril(G)
     return low + jnp.conj(jnp.swapaxes(jnp.tril(G, -1), -1, -2))
 

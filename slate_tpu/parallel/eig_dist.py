@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 
 
 @lru_cache(maxsize=32)
@@ -114,7 +114,7 @@ def _he2hb_shard_fn(mesh, npad: int, nb: int, dtype_str: str):
             jnp.zeros_like(A_loc))
         return band_loc, Vs_loc, Ts
 
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=P(AX, None),
+    fn = shard_map(local_fn, mesh=mesh, in_specs=P(AX, None),
                        out_specs=(P(AX, None), P(None, AX, None), P(None)),
                        check_vma=False)
     return jax.jit(fn)
@@ -143,7 +143,7 @@ def _unmtr_he2hb_shard_fn(mesh, npad: int, ncols: int, nb: int, nj: int,
 
         return lax.fori_loop(0, nj, body, C_loc)
 
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(P(None, AX, None), P(None), P(AX, None)),
                        out_specs=P(AX, None), check_vma=False)
     return jax.jit(fn)
@@ -195,9 +195,10 @@ def _twostage_stage12(A, grid: ProcessGrid, nb: int,
     segment-parallel eligibility floor applied in ONE place so the full and
     subset drivers cannot diverge).
 
-    Returns ``(d, e_c, Vcs, tcs, Vs1, Ts1, factor)``; with
-    ``want_tape=False`` the reflector tape entries are None and ``e_c`` is
-    already the real |e|."""
+    Returns the 8-tuple ``(d, e_c, Vcs, tcs, Vs1, Ts1, factor, nb_eff)`` —
+    ``nb_eff`` is the clamped bandwidth the caller must reuse for the
+    back-transforms.  With ``want_tape=False`` the reflector tape entries
+    (``Vcs``, ``tcs``) are None and ``e_c`` is already the real ``|e|``."""
     from ..linalg.eig import _safe_scale, hb2st, hb2st_reflectors
 
     n = A.shape[-1]
@@ -221,15 +222,15 @@ def _twostage_stage12(A, grid: ProcessGrid, nb: int,
 
         d, e_c, Vcs, tcs = hb2st_chase_distributed(band, nb, grid,
                                                    want_vectors=want_tape)
-        if not want_tape:
-            return d, jnp.abs(e_c), None, None, Vs1, Ts1, factor, nb
     elif want_tape:
         d, e_c, Vcs, tcs = hb2st_reflectors(band, kd=nb,
                                             pipeline=chase_pipeline)
     else:
-        d, e = hb2st(band, kd=nb, want_vectors=False,
-                     pipeline=chase_pipeline)
-        return d, e, None, None, Vs1, Ts1, factor, nb
+        # hb2st already returns the real |e|; jnp.abs below is a no-op
+        d, e_c = hb2st(band, kd=nb, want_vectors=False,
+                       pipeline=chase_pipeline)
+        Vcs = tcs = None
+    # single exit: the tape-less form drops the reflectors and realizes |e|
     if not want_tape:
         return d, jnp.abs(e_c), None, None, Vs1, Ts1, factor, nb
     return d, e_c, Vcs, tcs, Vs1, Ts1, factor, nb
@@ -352,7 +353,7 @@ def _ge2tb_shard_fn(mesh, mpad: int, npc: int, nreal: int, nb: int,
             jnp.zeros_like(A_loc))
         return band_loc, Vu_loc, Tu, Vv, Tv
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local_fn, mesh=mesh, in_specs=P(AX, None),
         out_specs=(P(AX, None), P(None, AX, None), P(None),
                    P(None, AX, None), P(None)),
@@ -579,7 +580,7 @@ def _hb2st_q_shard_fn(mesh, n: int, npad: int):
         q = sweep_accumulate(Vs, taus, n, Vs.shape[-1], Q0=q0)
         return q * phase[None, :]
 
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(P(None), P(None), P(None)),
                        out_specs=P(AX, None), check_vma=False)
     return jax.jit(fn)
@@ -621,7 +622,7 @@ def _steqr_shard_fn(mesh):
     def local_fn(d, e, z_loc):
         return steqr_qr(d, e, z_loc)
 
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(P(None), P(None), P(AX, None)),
                        out_specs=(P(None), P(AX, None)), check_vma=False)
     return jax.jit(fn)

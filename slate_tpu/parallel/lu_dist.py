@@ -47,8 +47,10 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.exceptions import slate_assert
+from ..robust import RetryPolicy, first_bad_index, guard_shards, inject
+from ..utils.trace import trace_event
 from .distribute import ceil_mult, lcm as _lcm
-from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 from .pivot import (exchange_rows as _exchange_rows,
                     step_permutation, tournament_piv)
 
@@ -106,9 +108,8 @@ def _lu_diag_info(A_loc, grow, gcol, npad):
     drow = jnp.sum(jnp.where(dmask, A_loc, jnp.zeros_like(A_loc)), axis=1)
     diag = jnp.zeros((npad,), A_loc.dtype).at[grow].set(drow)
     diag = lax.psum(lax.psum(diag, ROW_AXIS), COL_AXIS)
-    bad = (diag == 0) | ~jnp.isfinite(diag)
-    return jnp.where(jnp.any(bad),
-                     jnp.argmax(bad).astype(jnp.int32) + 1, jnp.int32(0))
+    # shared info kernel (robust.first_bad_index, reduce_info semantics)
+    return first_bad_index((diag == 0) | ~jnp.isfinite(diag))
 
 
 @lru_cache(maxsize=32)
@@ -189,7 +190,7 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     # perm/info are computed identically on every shard (their inputs are all
     # psum/all_gather results), but the vma system cannot prove replication
     # through the swap fori_loops — the unsharded out_specs assert it.
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=spec,
+    fn = shard_map(local_fn, mesh=mesh, in_specs=spec,
                        out_specs=(spec, P(None), P()), check_vma=False)
     return jax.jit(fn)
 
@@ -288,7 +289,8 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
         perm0 = jnp.arange(mpad, dtype=jnp.int32)
         A_loc, perm = lax.fori_loop(0, nt, step, (A_loc, perm0))
 
-        # info: first zero diagonal of U (cols ∩ my rows, psum-assembled)
+        # info: first zero diagonal of U (cols ∩ my rows, psum-assembled;
+        # shared kernel robust.first_bad_index)
         on_diag = (grow[:, None] == gcol[None, :])
         drow = jnp.sum(jnp.where(on_diag, A_loc, jnp.zeros_like(A_loc)),
                        axis=1)
@@ -297,13 +299,11 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
             jnp.where(in_range, grow, npc)].add(
                 jnp.where(in_range, drow, jnp.zeros_like(drow)), mode="drop")
         diag = lax.psum(diag, AX)
-        info = jnp.where(jnp.any(diag == 0),
-                         jnp.argmax(diag == 0).astype(jnp.int32) + 1,
-                         jnp.int32(0))
+        info = first_bad_index(diag == 0)
         return A_loc, perm, info
 
     spec = P(AX, None)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=spec,
+    fn = shard_map(local_fn, mesh=mesh, in_specs=spec,
                        out_specs=(spec, P(None), P()), check_vma=False)
     return jax.jit(fn)
 
@@ -459,10 +459,23 @@ def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                      nb: int = 256):
     """Distributed general solve A X = B (src/gesv.cc = getrf + getrs).
 
+    Runs under the failed-shard guard (robust.guard_shards): when a fault
+    plan simulates a dead device (shard_fail at the "output" point), a
+    non-finite result re-runs factor AND solve from the intact input — the
+    honest recovery.  Zero extra host syncs when no chaos is active.
+
     Returns ``(X, info)``.
     """
-    LU, perm, info = getrf_distributed(A, grid, nb=nb)
-    return getrs_distributed(LU, perm, B, grid), info
+    state = {}
+
+    def run():
+        LU, perm, info = getrf_distributed(inject("gesv_distributed", A),
+                                           grid, nb=nb)
+        state["info"] = info
+        return getrs_distributed(LU, perm, B, grid)
+
+    X, _ = guard_shards("gesv_distributed", run, RetryPolicy(max_retries=1))
+    return X, state["info"]
 
 
 def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
@@ -488,6 +501,8 @@ def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     X, iters, ok = _ir_refine_distributed(A, B, solve_lo, grid,
                                           max_iterations)
     if not bool(ok):                      # the solve's single host sync
+        # mixed→full ladder (robust.LADDERS["gesv_mixed_distributed"])
+        trace_event("fallback", routine="gesv_mixed_distributed", to="full")
         LU, perm, info = getrf_distributed(A, grid, nb=nb)
         return (getrs_distributed(LU, perm, B, grid), perm, info, int(iters),
                 False)
@@ -538,6 +553,8 @@ def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
     if not converged:
         if not opts.use_fallback_solver:
             return X, perm, info, int(restarts), False
+        trace_event("fallback", routine="gesv_mixed_gmres_distributed",
+                    to="full")
         Xf, permf, infof = fallback()
         return Xf, permf, infof, int(restarts), False
     return X, perm, info, int(restarts), True

@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult, lcm as _lcm
-from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 
 
 @lru_cache(maxsize=32)
@@ -89,7 +89,7 @@ def _getrf_nopiv_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
         return A_loc, _lu_diag_info(A_loc, grow, gcol, npad)
 
     spec = P(ROW_AXIS, COL_AXIS)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=spec,
+    fn = shard_map(local_fn, mesh=mesh, in_specs=spec,
                        out_specs=(spec, P()), check_vma=False)
     return jax.jit(fn)
 
@@ -136,10 +136,12 @@ def gesv_rbt_distributed(A, B, grid: ProcessGrid, depth: int = 2,
     """Distributed solve via random butterfly transform + nopiv LU +
     refinement (src/gesv_rbt.cc:94-172 over the mesh).
 
-    Returns ``(X, info, iters)`` with the gesv_rbt contract: info from the
-    nopiv factor, iters from the IR loop; on IR stall (the transform failed
-    to tame a pathological matrix) the sharded pivoted solve takes over,
-    matching Option::UseFallbackSolver (gesv_rbt.cc fallback path).
+    Returns ``(X, info, iters, via_rbt)`` with the gesv_rbt contract: info
+    from the nopiv factor, iters from the IR loop; on IR stall (the
+    transform failed to tame a pathological matrix) the sharded pivoted
+    solve takes over, matching Option::UseFallbackSolver (gesv_rbt.cc
+    fallback path), and ``via_rbt`` is False so callers can report which
+    rung actually produced the result.
     """
     from ..linalg.lu import _butterfly_apply, rbt_generate
     from .lu_dist import gesv_distributed
@@ -187,6 +189,12 @@ def gesv_rbt_distributed(A, B, grid: ProcessGrid, depth: int = 2,
 
     X, iters, ok = _ir_refine_distributed(a, b2, solve_lo, grid,
                                           max_iterations, tol=tol)
-    if use_fallback and not bool(ok):     # the solve's single host sync
+    via_rbt = bool(ok)                    # the solve's single host sync
+    if use_fallback and not via_rbt:
+        # rbt→partialpiv ladder (robust.LADDERS["gesv_rbt_distributed"])
+        from ..utils.trace import trace_event
+
+        trace_event("fallback", routine="gesv_rbt_distributed",
+                    to="partialpiv")
         X, info = gesv_distributed(a, b2, grid, nb=nb)
-    return (X[:, 0] if vec else X), info, iters
+    return (X[:, 0] if vec else X), info, iters, via_rbt

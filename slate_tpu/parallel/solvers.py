@@ -30,7 +30,9 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from ..linalg.chol import _chol_blocked
 from ..ops import blas3
-from .mesh import COL_AXIS, ROW_AXIS, ProcessGrid
+from ..robust import RetryPolicy, Rung, guard_shards, inject, run_ladder
+from ..utils.trace import trace_event
+from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -205,10 +207,21 @@ def trsm_distributed(L: jax.Array, B: jax.Array, grid: ProcessGrid,
 
 def posv_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
                      nb: int = 256) -> jax.Array:
-    """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc), all sharded."""
-    L = potrf_distributed(Af, grid, nb)
-    Y = trsm_distributed(L, B, grid, lower=True, conj_trans=False)
-    return trsm_distributed(L, Y, grid, lower=True, conj_trans=True)
+    """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc), all sharded.
+
+    The whole solve runs under the failed-shard guard
+    (robust.guard_shards): when a fault plan simulates a dead device
+    (shard_fail at the "output" point) or chaos is otherwise active, a
+    non-finite result re-runs the solve from the intact input — zero extra
+    host syncs on the production path."""
+
+    def run():
+        L = potrf_distributed(inject("posv_distributed", Af), grid, nb)
+        Y = trsm_distributed(L, B, grid, lower=True, conj_trans=False)
+        return trsm_distributed(L, Y, grid, lower=True, conj_trans=True)
+
+    X, _ = guard_shards("posv_distributed", run, RetryPolicy(max_retries=1))
+    return X
 
 
 _FLAT = (ROW_AXIS, COL_AXIS)      # flattened device axis for 1-D row layouts
@@ -276,7 +289,7 @@ def _trsmA_dist_fn(mesh, npad: int, nb: int, nrhs: int, lower: bool,
         X = lax.fori_loop(0, nt, body, jnp.zeros_like(b))
         return X
 
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(P(_FLAT, None), P(None, None)),
                        out_specs=P(None, None), check_vma=False)
     return jax.jit(fn)
@@ -354,28 +367,40 @@ def _ir_refine_distributed(Af, B, solve_lo, grid, max_iterations, tol=None):
 def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
                            nb: int = 256, max_iterations: int = 30):
     """Distributed mixed-precision SPD solve (src/posv_mixed.cc over the mesh):
-    factor in the next precision down (f64->f32, c128->c64; f32 has no lower
-    rung — XLA's Cholesky rejects bf16 — so f32 inputs take the plain sharded
-    solve), refine the residual at working precision, fall back to the
-    full-precision sharded solve if IR stalls (Option::UseFallbackSolver).
+    factor in the next precision down (f32 has no lower rung — XLA's Cholesky
+    rejects bf16 — so f32 inputs take the plain sharded solve), refine the
+    residual at working precision, escalate along the declared mixed→full
+    ladder (robust.LADDERS["posv_mixed_distributed"]) when IR stalls.
 
     Returns (X, iters, converged_via_ir).
     """
     lo = _lower_dtype(Af.dtype)
     if lo is None:
         return posv_distributed(Af, B, grid, nb=nb), 0, True
-    L = potrf_distributed(Af.astype(lo), grid, nb=nb)
+    state = {"iters": 0}
 
-    def solve_lo(R):
-        Y = trsm_distributed(L, R.astype(lo), grid, lower=True,
-                             conj_trans=False)
-        return trsm_distributed(L, Y, grid, lower=True, conj_trans=True)
+    def mixed_rung():
+        L = potrf_distributed(
+            inject("posv_mixed_distributed", Af.astype(lo), point="factor"),
+            grid, nb=nb)
 
-    X, iters, ok = _ir_refine_distributed(Af, B, solve_lo, grid,
-                                          max_iterations)
-    if not bool(ok):                      # the solve's single host sync
-        return posv_distributed(Af, B, grid, nb=nb), int(iters), False
-    return X, int(iters), True
+        def solve_lo(R):
+            Y = trsm_distributed(L, R.astype(lo), grid, lower=True,
+                                 conj_trans=False)
+            return trsm_distributed(L, Y, grid, lower=True, conj_trans=True)
+
+        X, iters, ok = _ir_refine_distributed(Af, B, solve_lo, grid,
+                                              max_iterations)
+        state["iters"] = int(iters)
+        return (X, True), bool(ok)        # the solve's single host sync
+
+    def full_rung():
+        return (posv_distributed(Af, B, grid, nb=nb), False), True
+
+    X, via_ir = run_ladder("posv_mixed_distributed",
+                           [Rung("mixed", mixed_rung),
+                            Rung("full", full_rung)])
+    return X, state["iters"], via_ir
 
 
 def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
@@ -419,6 +444,8 @@ def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
     if not converged:
         if not opts.use_fallback_solver:
             return X, int(restarts), False
+        trace_event("fallback", routine="posv_mixed_gmres_distributed",
+                    to="full")
         return fallback(), int(restarts), False
     return X, int(restarts), True
 
@@ -462,7 +489,7 @@ def _cholqr_fn(mesh, precision):
         bad = ~jnp.all(jnp.isfinite(jnp.diagonal(Rg)))
         return lax.cond(bad, householder_path, gram_path, None)
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=in_spec,
+    fn = shard_map(local, mesh=mesh, in_specs=in_spec,
                        out_specs=(in_spec, P(None, None)), check_vma=False)
     return jax.jit(fn)
 

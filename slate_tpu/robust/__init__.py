@@ -1,0 +1,30 @@
+"""slate_tpu.robust — the unified solver-resilience layer.
+
+Three parts (see README.md "Failure handling & fault injection"):
+
+* **Fault injection** (:mod:`.faults`): :class:`FaultPlan` /
+  :class:`FaultSpec` — seeded, deterministic, jit-compatible corruption of
+  driver operands/factors/outputs, addressed by driver name, call index, and
+  tile coordinate.  Drivers opt in with one :func:`inject` call per boundary.
+* **Health propagation** (:mod:`.report`): :class:`SolveReport` (opt-in via
+  ``Options(solve_report=True)``), plus the shared info kernels
+  :func:`first_bad_index` / :func:`reduce_info` used by every factorization
+  (the reference's ``internal::reduce_info`` made one function).
+* **Escalation policies** (:mod:`.policy`): :class:`RetryPolicy`,
+  :class:`Rung` / :func:`run_ladder` (host-level declared ladders: mixed→full,
+  RBT→partial-pivot, nopiv→partial-pivot), :func:`guard_shards` (failed-shard
+  detection + re-run for distributed solves), and the :data:`LADDERS`
+  registry documenting every driver's escalation order — including the
+  in-trace ``lax.cond`` ladders (CholQR→Householder) that stay inside jit.
+"""
+
+from .faults import (FaultPlan, FaultSpec, POINT_FACTOR, POINT_INPUT,
+                     POINT_OUTPUT, active, inject)
+from .policy import LADDERS, RetryPolicy, Rung, guard_shards, run_ladder
+from .report import SolveReport, first_bad_index, reduce_info
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "POINT_FACTOR", "POINT_INPUT", "POINT_OUTPUT",
+    "active", "inject", "LADDERS", "RetryPolicy", "Rung", "guard_shards",
+    "run_ladder", "SolveReport", "first_bad_index", "reduce_info",
+]

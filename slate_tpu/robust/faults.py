@@ -1,0 +1,226 @@
+"""Deterministic fault injection for solver chaos testing.
+
+Reference motivation: SLATE's drivers *detect* numerical failure (info codes
+reduced across ranks, internal_reduce_info.cc) and *recover* (gesv_mixed.cc's
+full-precision fallback, gesv_rbt.cc's pivoted retry) — but nothing in the
+reference can *exercise* those paths on demand; they fire only when a user
+matrix happens to be pathological.  This module makes failure a first-class,
+reproducible input: a :class:`FaultPlan` is a seeded, declarative list of
+corruptions addressed by driver name, call index, and tile coordinate, applied
+at driver boundaries through :func:`inject`.
+
+Design constraints (TPU-native):
+
+* **jit-compatible** — corruptions are pure array→array functions built from
+  ``jnp.where`` index masks, so an injected operand traces exactly like a
+  clean one (no shape changes, no host branches inside the program).
+* **deterministic** — the only randomness is ``jax.random`` keyed off the
+  plan's seed (the ``ir_stall`` perturbation); no wall clock, no global RNG.
+  Two runs of the same plan against the same calls corrupt identically.
+* **host-level addressing** — drivers call ``inject(name, x, point=...)`` at
+  their (host-side) entry/factor/output boundaries, exactly where the
+  reference's drivers sit between MPI and the math; the plan counts calls per
+  ``(driver, point)`` site so a fault can target "the third getrf".
+
+Fault classes (the chaos vocabulary of tests/test_robust.py):
+
+``nan_tile`` / ``inf_tile``
+    Corrupt one nb×nb tile of the operand with NaN/Inf — a poisoned input or
+    a dropped DMA.
+``zero_pivot``
+    Zero row+column ``index`` — forces a structurally singular pivot, the
+    LAPACK info>0 class.
+``ir_stall``
+    Multiplicatively perturb a low-precision *factor* (point="factor") so the
+    preconditioner goes bad and iterative refinement stalls, driving the
+    mixed→full escalation ladder.
+``shard_fail``
+    NaN-fill the rows owned by shard ``index`` of ``world`` at a distributed
+    solve's *output* (point="output") — a device dropping out mid-collective;
+    the retry guard (robust.policy.guard_shards) detects and re-runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.trace import trace_event
+
+# injection points: where along a driver's lifetime a fault lands
+POINT_INPUT = "input"      # operand at driver entry
+POINT_FACTOR = "factor"    # low-precision / intermediate factor
+POINT_OUTPUT = "output"    # solve result (distributed shard failures)
+
+_KIND_POINT = {
+    "nan_tile": POINT_INPUT,
+    "inf_tile": POINT_INPUT,
+    "zero_pivot": POINT_INPUT,
+    "ir_stall": POINT_FACTOR,
+    "shard_fail": POINT_OUTPUT,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One declared corruption.
+
+    driver:     the site name drivers pass to :func:`inject` ("getrf",
+                "posv_mixed", "gesv_distributed", ...).
+    kind:       one of ``nan_tile | inf_tile | zero_pivot | ir_stall |
+                shard_fail``.
+    call_index: which invocation of that (driver, point) site to hit
+                (0 = first).  A retried solve re-enters the site with the
+                next index, so a call_index=0 fault is transient by
+                construction.
+    tile:       (i, j) tile coordinate for the tile corruptions.
+    nb:         tile edge for the tile corruptions.
+    index:      pivot index (zero_pivot) / failed shard id (shard_fail).
+    world:      shard count for shard_fail (rows split evenly).
+    scale:      multiplicative magnitude for ir_stall (≫1 ⇒ the perturbed
+                factor's solve contracts the residual by ~1/scale² per sweep
+                — a guaranteed stall at the default tolerance).
+    """
+
+    driver: str
+    kind: str
+    call_index: int = 0
+    tile: Tuple[int, int] = (0, 0)
+    nb: int = 32
+    index: int = 0
+    world: int = 8
+    scale: float = 1e3
+
+    def __post_init__(self):
+        if self.kind not in _KIND_POINT:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"expected one of {sorted(_KIND_POINT)}")
+
+    @property
+    def point(self) -> str:
+        return _KIND_POINT[self.kind]
+
+
+# active-plan stack (plans nest; innermost wins the call accounting)
+_ACTIVE: List["FaultPlan"] = []
+
+
+class FaultPlan:
+    """A seeded, context-manager-driven set of :class:`FaultSpec`\\ s.
+
+    ::
+
+        plan = FaultPlan([FaultSpec("potrf", "nan_tile", tile=(1, 1), nb=16)],
+                         seed=7)
+        with plan:
+            L, info = slate.potrf(A)     # tile (1,1) arrives as NaN
+        assert plan.fired == (("potrf", "nan_tile", 0),)
+
+    The plan is exhausted-by-position, not consumed: entering the context
+    resets the per-site call counters, so the same plan object replays
+    identically (the determinism contract of tests/test_robust.py).
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self._counts = {}
+        self._fired: List[Tuple[str, str, int]] = []
+
+    # -- context management -------------------------------------------------
+    def __enter__(self) -> "FaultPlan":
+        self.reset()
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE.remove(self)
+
+    def reset(self) -> None:
+        """Clear call counters and the fired log (replay from the top)."""
+        self._counts = {}
+        self._fired = []
+
+    @property
+    def fired(self) -> Tuple[Tuple[str, str, int], ...]:
+        """(driver, kind, call_index) triples of faults that actually fired."""
+        return tuple(self._fired)
+
+    # -- the injection core -------------------------------------------------
+    def _take(self, driver: str, point: str) -> List[FaultSpec]:
+        idx = self._counts.get((driver, point), 0)
+        self._counts[(driver, point)] = idx + 1
+        hits = [s for s in self.specs
+                if s.driver == driver and s.point == point
+                and s.call_index == idx]
+        for s in hits:
+            self._fired.append((driver, s.kind, idx))
+        return hits
+
+
+def active() -> Optional[FaultPlan]:
+    """The innermost active plan, or None (drivers use this to skip the
+    output-finiteness host sync when no chaos is requested)."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def _tile_mask(shape, tile: Tuple[int, int], nb: int):
+    i, j = tile
+    r = jnp.arange(shape[-2])
+    c = jnp.arange(shape[-1])
+    rm = (r >= i * nb) & (r < (i + 1) * nb)
+    cm = (c >= j * nb) & (c < (j + 1) * nb)
+    return rm[:, None] & cm[None, :]
+
+
+def _apply(spec: FaultSpec, x: jax.Array, seed: int) -> jax.Array:
+    x = jnp.asarray(x)
+    if spec.kind in ("nan_tile", "inf_tile"):
+        val = jnp.asarray(jnp.nan if spec.kind == "nan_tile" else jnp.inf,
+                          x.dtype)
+        return jnp.where(_tile_mask(x.shape, spec.tile, spec.nb), val, x)
+    if spec.kind == "zero_pivot":
+        k = spec.index
+        r = jnp.arange(x.shape[-2])
+        c = jnp.arange(x.shape[-1])
+        mask = (r == k)[:, None] | (c == k)[None, :]
+        return jnp.where(mask, jnp.zeros((), x.dtype), x)
+    if spec.kind == "ir_stall":
+        # seeded multiplicative perturbation of the factor: scale · U[0.5,1.5)
+        # — finite, so the stalled IR loop runs its full budget instead of
+        # NaN-exiting, exercising the max_iterations path
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), spec.call_index)
+        u = jax.random.uniform(key, x.shape, jnp.float32, 0.5, 1.5)
+        return x * (spec.scale * u).astype(x.dtype)
+    if spec.kind == "shard_fail":
+        rows = x.shape[-2] if x.ndim >= 2 else x.shape[-1]
+        per = -(-rows // max(spec.world, 1))
+        r = jnp.arange(rows)
+        dead = (r >= spec.index * per) & (r < (spec.index + 1) * per)
+        # align the dead-row mask with the ROW (-2) axis so batched
+        # (ndim >= 3) solver outputs broadcast instead of crashing
+        shape = ((1,) * (x.ndim - 2) + (rows, 1)) if x.ndim >= 2 \
+            else dead.shape
+        return jnp.where(dead.reshape(shape), jnp.asarray(jnp.nan, x.dtype), x)
+    raise AssertionError(spec.kind)  # unreachable (validated in __post_init__)
+
+
+def inject(driver: str, x, point: str = POINT_INPUT):
+    """Driver-boundary hook: pass ``x`` through the active plan.
+
+    Returns ``x`` untouched when no plan is active or no spec matches this
+    (driver, point, call) site — the zero-overhead production path (one dict
+    lookup).  Matching specs corrupt functionally (``jnp.where`` masks), emit
+    a ``fault_inject`` trace event, and are logged on the plan.
+    """
+    plan = active()
+    if plan is None:
+        return x
+    for spec in plan._take(driver, point):
+        x = _apply(spec, x, plan.seed)
+        trace_event("fault_inject", driver=driver, kind=spec.kind,
+                    point=point, call=spec.call_index)
+    return x

@@ -1,0 +1,166 @@
+"""Escalation policies: declared fallback ladders + host-level retry.
+
+Reference analogue: the fallback behaviors SLATE hard-codes per driver —
+``gesv_mixed.cc:93-96`` (Option::UseFallbackSolver re-solves at full
+precision), ``gesv_rbt.cc``'s pivoted retry, ``gels_cholqr``'s Householder
+escape — each open-coded at its call site.  Here a driver *declares* its
+ladder and the one engine runs it, so every driver gets the same retry
+accounting, trace events, and report wiring (the BLASX argument: runtime
+health policy belongs in the library layer, PAPERS.md).
+
+Two mechanisms:
+
+* :func:`run_ladder` — host-level escalation over :class:`Rung`\\ s.  A rung
+  is ``(name, fn)`` with ``fn() -> (payload, ok)``; the first rung whose
+  ``ok`` verdict (the solve's single host sync) holds wins.  Exhaustion
+  either raises :class:`~slate_tpu.core.exceptions.ConvergenceError` or
+  returns the last payload with ``recovered=False`` recorded on the report.
+* :func:`guard_shards` — the failed-shard guard for distributed solves: the
+  result passes through ``inject(..., point="output")`` (where a FaultPlan
+  simulates a dead device) and, when chaos is active or checking is forced,
+  non-finite results re-run the whole solve up to ``max_retries`` times.
+
+In-trace ladders (cholqr's Gram→shifted→Householder ``lax.cond`` chain,
+CSNE's QR escape) intentionally stay inside their jitted programs — hoisting
+them to the host would cost a sync per call; they are declared in
+:data:`LADDERS` so the escalation order is documented in one place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..core.exceptions import ConvergenceError
+from ..utils.trace import trace_event
+from .faults import POINT_OUTPUT, active, inject
+from .report import SolveReport
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Host-level retry knobs for one solve.
+
+    max_retries: same-rung re-runs before escalating to the next rung (used
+                 by the shard guard and by rungs whose failure can be
+                 transient); 0 = escalate immediately.
+    backoff:     seconds to sleep between host-level retries (0 = none; chaos
+                 tests keep it 0 so injection stays wall-clock-free).
+    ladder:      informational rung names for reports/traces; drivers
+                 normally take these from :data:`LADDERS`.
+    """
+
+    max_retries: int = 0
+    backoff: float = 0.0
+    ladder: Tuple[str, ...] = ()
+
+    @classmethod
+    def from_options(cls, opts, routine: str = "") -> "RetryPolicy":
+        return cls(max_retries=getattr(opts, "max_retries", 0),
+                   backoff=getattr(opts, "retry_backoff", 0.0),
+                   ladder=LADDERS.get(routine, ()))
+
+
+#: The declared escalation ladders — the previously implicit per-driver
+#: fallbacks, codified (first rung = fast path, later rungs = escalations).
+LADDERS = {
+    "gesv_mixed": ("mixed", "full"),
+    "gesv_mixed_gmres": ("mixed_gmres", "full"),
+    "posv_mixed": ("mixed", "full"),
+    "posv_mixed_gmres": ("mixed_gmres", "full"),
+    "gesv_rbt": ("rbt", "partialpiv"),
+    "gesv_nopiv": ("nopiv", "partialpiv"),
+    "posv_mixed_distributed": ("mixed", "full"),
+    "gesv_mixed_distributed": ("mixed", "full"),
+    "gesv_rbt_distributed": ("rbt", "partialpiv"),
+    # in-trace (lax.cond) ladders — documented here, executed inside jit:
+    "cholqr": ("cholqr", "shifted_cholqr", "householder"),
+    "gels_cholqr": ("csne", "householder"),
+}
+
+
+class Rung(NamedTuple):
+    """One escalation step: ``run() -> (payload, ok)`` with ``ok`` a host
+    bool (the rung's single device→host sync)."""
+
+    name: str
+    run: Callable[[], Tuple[object, bool]]
+
+
+def _sleep(seconds: float) -> None:
+    if seconds > 0:
+        time.sleep(seconds)
+
+
+def run_ladder(routine: str, rungs: Sequence[Rung],
+               policy: Optional[RetryPolicy] = None,
+               report: Optional[SolveReport] = None,
+               raise_on_exhaust: bool = False):
+    """Execute an escalation ladder; returns the winning payload.
+
+    Each rung runs ``1 + policy.max_retries`` times before the engine
+    escalates (retries re-enter the fault-plan call accounting, so transient
+    injected faults clear on retry).  Every escalation emits a ``fallback``
+    trace event; retries emit ``retry``.  When a report is supplied it
+    accumulates the rung chain, retry count, and the recovered verdict.
+    """
+    policy = policy or RetryPolicy()
+    payload, ok = None, False
+    for depth, rung in enumerate(rungs):
+        if depth > 0:
+            trace_event("fallback", routine=routine, to=rung.name)
+        for attempt in range(1 + max(policy.max_retries, 0)):
+            if attempt > 0:
+                trace_event("retry", routine=routine, rung=rung.name,
+                            attempt=attempt)
+                _sleep(policy.backoff)
+                if report is not None:
+                    report.retries += 1
+            payload, ok = rung.run()
+            if ok:
+                break
+        if report is not None:
+            report.record_rung(rung.name)
+        if ok:
+            break
+    if report is not None:
+        report.recovered = bool(ok)
+    if not ok and raise_on_exhaust:
+        raise ConvergenceError(
+            f"{routine}: escalation ladder "
+            f"{tuple(r.name for r in rungs)} exhausted", report=report)
+    return payload
+
+
+def guard_shards(routine: str, run: Callable[[], object],
+                 policy: Optional[RetryPolicy] = None,
+                 check: bool = False):
+    """Failed-shard guard for distributed solves.
+
+    ``run()`` executes the full sharded solve and returns its result array;
+    the result passes through the fault plan's ``output`` point (where
+    ``shard_fail`` simulates a dead device).  When a plan is active — or
+    ``check=True`` forces it — a non-finite result triggers up to
+    ``policy.max_retries`` full re-runs (recompute from the intact input, the
+    honest recovery; the re-run's injection call index advances so a
+    transient fault clears).  Returns ``(result, retries_taken)``.
+
+    With no plan and ``check=False`` this adds zero host syncs — the
+    production path is one function call and one dict lookup.
+    """
+    policy = policy or RetryPolicy(max_retries=1)
+    X = inject(routine, run(), point=POINT_OUTPUT)
+    if active() is None and not check:
+        return X, 0
+    retries = 0
+    while retries < max(policy.max_retries, 0) and \
+            not bool(jnp.all(jnp.isfinite(X))):
+        trace_event("retry", routine=routine, rung="shard_recover",
+                    attempt=retries + 1)
+        _sleep(policy.backoff)
+        X = inject(routine, run(), point=POINT_OUTPUT)
+        retries += 1
+    return X, retries

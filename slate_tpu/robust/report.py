@@ -1,0 +1,97 @@
+"""Structured health propagation: SolveReport + the shared info combiner.
+
+Reference analogue: every SLATE driver carries an ``int64_t info`` reduced
+across ranks by ``internal::reduce_info`` (src/internal/internal_reduce_info.cc)
+— MPI_MAX over per-rank codes, first-failure-wins.  Our drivers previously
+mixed three conventions (raised exceptions, silent NaN poison, bare ints);
+this module is the single vocabulary:
+
+* :func:`first_bad_index` — the LAPACK-info kernel every factorization shares:
+  1-based index of the first failing pivot, 0 on success (jit-safe).
+* :func:`reduce_info` — combine stage infos, first nonzero wins (the
+  reduce_info tree collapsed to a jnp.where chain; jit-safe).
+* :class:`SolveReport` — the opt-in structured result
+  (``Options.solve_report=True``) describing what a solve actually did:
+  info, precision used, refinement iterations, host-level retries, and the
+  escalation rungs attempted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def first_bad_index(bad) -> "jnp.ndarray":
+    """LAPACK-style info from a boolean failure mask (1-based first True, else 0).
+
+    The shared kernel behind LU's zero/NaN
+    U-diagonal check, Cholesky's non-positive-pivot check, and the band/
+    indefinite variants (reference reduce_info semantics, computed
+    functionally so it stays inside the jitted program)."""
+    bad = jnp.asarray(bad)
+    return jnp.where(jnp.any(bad),
+                     jnp.argmax(bad).astype(jnp.int32) + 1, jnp.int32(0))
+
+
+def reduce_info(*infos) -> "jnp.ndarray":
+    """Combine per-stage info codes; the first nonzero (in argument order) wins.
+
+    0 when all stages succeeded — ``internal::reduce_info`` with the rank
+    dimension replaced by the stage dimension.  Accepts python ints and
+    traced arrays; jit-safe."""
+    out = jnp.int32(0)
+    for i in infos:
+        i32 = jnp.asarray(i).astype(jnp.int32)
+        out = jnp.where(out != 0, out, i32)
+    return out
+
+
+@dataclasses.dataclass
+class SolveReport:
+    """Structured record of what a solve actually did.
+
+    The alternative to inferring health from NaNs: opt-in via
+    ``Options(solve_report=True)``; drivers append the report to their
+    normal return tuple.
+
+    routine:         driver name ("gesv_mixed", "posv", ...).
+    info:            final LAPACK-style info code (host int).
+    residual:        final residual estimate when the driver computed one.
+    precision_used:  dtype the *returned* result was computed in (after any
+                     escalation; "float32→float64" style for mixed paths that
+                     fell back).
+    iters:           refinement/restart iterations taken.
+    retries:         host-level same-rung retries (shard failures).
+    fallback_chain:  escalation rungs attempted, in order ("mixed", "full").
+    recovered:       True when the returned result came from a rung that
+                     converged/succeeded; False when every rung failed and
+                     the driver surfaced the best effort + nonzero info.
+    faults:          (driver, kind, call_index) triples injected during the
+                     solve (empty outside chaos tests).
+    """
+
+    routine: str
+    info: int = 0
+    residual: Optional[float] = None
+    precision_used: str = ""
+    iters: int = 0
+    retries: int = 0
+    fallback_chain: Tuple[str, ...] = ()
+    recovered: bool = True
+    faults: Tuple[Tuple[str, str, int], ...] = ()
+
+    def record_rung(self, name: str) -> None:
+        self.fallback_chain = self.fallback_chain + (name,)
+
+    def finalize(self) -> "SolveReport":
+        """Attach the faults that fired on the active plan (if any) — called
+        by drivers just before returning the report."""
+        from . import faults as _faults
+
+        plan = _faults.active()
+        if plan is not None:
+            self.faults = plan.fired
+        return self

@@ -8,5 +8,17 @@ reference's single ``tester`` binary with its routine dispatch table
 from .sweeper import ParamSweep, TestResult, format_table, parse_dims, parse_list
 from .routines import ROUTINES, run_routine
 
+
+def cost_analysis_dict(compiled) -> dict:
+    """XLA ``Compiled.cost_analysis()`` across jax versions: newer jax returns
+    one dict, older jax a one-element list of dicts.  HLO-pin tests go through
+    this so a version bump cannot silently turn a resource assertion into an
+    AttributeError."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca
+
+
 __all__ = ["ParamSweep", "TestResult", "format_table", "parse_dims", "parse_list",
-           "ROUTINES", "run_routine"]
+           "ROUTINES", "run_routine", "cost_analysis_dict"]

@@ -100,6 +100,25 @@ def trace_block(name: str, **attrs):
             _events.append(ev)
 
 
+def trace_event(name: str, **attrs) -> None:
+    """Record an instant event (chrome-trace ph='i') — the hook the resilience
+    layer uses to mark retries, fallback escalations, and injected faults so
+    they line up with the surrounding ``trace_block`` regions in one timeline
+    (the reference's Trace.cc has no analogue; its recovery paths are
+    invisible in the SVG).  No-op while tracing is off."""
+    if not _enabled:
+        return
+    ev = {
+        "name": name, "ph": "i", "cat": "slate.robust", "s": "t",
+        "ts": (time.perf_counter() - _t0) * 1e6,
+        "pid": os.getpid(), "tid": threading.get_ident() % 2**31,
+    }
+    if attrs:
+        ev["args"] = {k: str(v) for k, v in attrs.items()}
+    with _events_lock:
+        _events.append(ev)
+
+
 def finish(path: Optional[str] = None) -> Optional[str]:
     """Write accumulated events as chrome://tracing JSON (reference
     Trace::finish writes trace_<time>.svg, Trace.cc:330-448). Returns the path."""

@@ -154,6 +154,17 @@ int main(void) {
       }
     }
     fails += check("dsyevx", maxe, 1e-8);
+
+    /* argument validation: info = -(1-based position of first bad arg);
+     * jobz='v' with Z==NULL must be rejected, not silently dropped */
+    double bad = 0;
+    if (slate_dsyevx('v', 'l', n, As, n, il, iu, Wx, NULL, n) != -9) bad = 1;
+    if (slate_dsyevx('v', 'l', n, As, n - 1, il, iu, Wx, Z, n) != -5) bad = 2;
+    if (slate_dsyevx('v', 'l', n, As, n, il, iu, Wx, Z, n - 1) != -10) bad = 3;
+    if (slate_dsyevx('v', 'l', n, As, n, 0, iu, Wx, Z, n) != -6) bad = 4;
+    if (slate_dsyevx('v', 'l', n, As, n, il, n + 1, Wx, Z, n) != -7) bad = 5;
+    if (slate_dsyevx('x', 'l', n, As, n, il, iu, Wx, Z, n) != -1) bad = 6;
+    fails += check("dsyevx_args", bad, 0.5);
     free(A); free(As); free(W); free(Wx); free(Z);
   }
 
@@ -179,6 +190,23 @@ int main(void) {
       }
     }
     fails += check("dgesvdx", maxe, 1e-8);
+
+    /* argument validation: info = -(1-based position of first bad arg);
+     * jobu/jobvt='v' with NULL U/VT must be rejected, not silently dropped */
+    double bad = 0;
+    if (slate_dgesvdx('v', 'v', m, n, As, m, 1, k, Sx, NULL, m, VT, k) != -10)
+      bad = 1;
+    if (slate_dgesvdx('v', 'v', m, n, As, m, 1, k, Sx, U, m, NULL, k) != -12)
+      bad = 2;
+    if (slate_dgesvdx('v', 'v', m, n, As, m - 1, 1, k, Sx, U, m, VT, k) != -6)
+      bad = 3;
+    if (slate_dgesvdx('v', 'v', m, n, As, m, 1, k, Sx, U, m - 1, VT, k) != -11)
+      bad = 4;
+    if (slate_dgesvdx('v', 'v', m, n, As, m, 1, k, Sx, U, m, VT, k - 1) != -13)
+      bad = 5;
+    if (slate_dgesvdx('v', 'v', m, n, As, m, 1, n + 1, Sx, U, m, VT, k) != -8)
+      bad = 6;
+    fails += check("dgesvdx_args", bad, 0.5);
     free(A); free(As); free(Sf); free(Sx); free(U); free(VT);
   }
 

@@ -357,3 +357,21 @@ def test_gemm_f64emu_sharded_operands(rng):
     ref = A.astype(np.float64) @ B.astype(np.float64)
     err = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
     assert err < 1e-12, err
+
+
+@pytest.mark.parametrize("n", [64, 300])
+def test_gram_complex_exactly_hermitian(rng, n):
+    """gram(x) must be exactly Hermitian for complex inputs: the strip mirror
+    handles the off-diagonal, and the diagonal's imaginary residue must be
+    forced to zero (it is mathematically sum |x|^2, i.e. real)."""
+    from slate_tpu.ops.blas3 import gram
+
+    x = (_rand(rng, 40, n, cplx=True)).astype(np.complex64)
+    G = np.asarray(gram(jnp.asarray(x)))
+    assert G.dtype == np.complex64
+    # exact Hermitian symmetry, not approximate: G == G^H bit-for-bit
+    np.testing.assert_array_equal(G, np.conj(G.T))
+    np.testing.assert_array_equal(np.imag(np.diagonal(G)), 0.0)
+    # and it is still the right Gram matrix
+    ref = np.conj(x.T) @ x
+    np.testing.assert_allclose(G, ref, rtol=0, atol=1e-3 * np.abs(ref).max())

@@ -289,3 +289,60 @@ def test_lapack_skin_syevx(rng):
     refc = np.linalg.eigvalsh(Ac)
     lamc, _ = lp.zheevx("N", "L", Ac.copy(), 1, 4)
     assert np.max(np.abs(lamc - refc[:4])) < 1e-11
+
+
+def test_heev_range_wrapper_grid_routes_to_mesh(rng):
+    """A wrapper bound to a >1-device grid must route heev_range to the
+    distributed subset pipeline (mirroring heev's dispatch) instead of
+    silently gathering the whole matrix onto one device."""
+    import jax
+
+    from slate_tpu.parallel import ProcessGrid
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    n, il, iu = 96, 10, 20
+    m = rng.standard_normal((n, n))
+    A = (m + m.T) / 2
+    ref = np.linalg.eigvalsh(A)
+    H = slate.HermitianMatrix.from_array("lower", jnp.asarray(A), nb=16,
+                                         grid=ProcessGrid(2, 4))
+    lam, Z = slate.heev_range(H, opts={"block_size": 16}, il=il, iu=iu)
+    assert np.max(np.abs(np.asarray(lam) - ref[il:iu])) < 1e-8
+    Zn = np.asarray(Z)
+    assert np.linalg.norm(A @ Zn - Zn * np.asarray(lam)[None, :]) < 1e-7
+
+
+def test_svd_range_wrapper_grid_routes_to_mesh(rng):
+    import jax
+
+    from slate_tpu.parallel import ProcessGrid
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    m_, n = 96, 64
+    A = rng.standard_normal((m_, n))
+    ref = np.linalg.svd(A, compute_uv=False)
+    Aw = slate.Matrix.from_array(jnp.asarray(A), nb=16,
+                                 grid=ProcessGrid(2, 4))
+    S, U, VT = slate.svd_range(Aw, opts={"block_size": 16}, il=0, iu=5)
+    assert np.max(np.abs(np.asarray(S) - ref[:5])) < 1e-8
+    assert np.linalg.norm(A @ np.asarray(VT).T
+                          - np.asarray(U) * np.asarray(S)[None, :]) < 1e-7
+
+
+def test_eig_count_wrapper_grid_rejected(rng):
+    """eig_count has no distributed pipeline: a grid-bound wrapper must get a
+    clear SlateError, not a silent single-device gather."""
+    import jax
+
+    from slate_tpu.parallel import ProcessGrid
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    n = 64
+    m = rng.standard_normal((n, n))
+    H = slate.HermitianMatrix.from_array("lower", jnp.asarray((m + m.T) / 2),
+                                         nb=16, grid=ProcessGrid(2, 4))
+    with pytest.raises(slate.SlateError, match="no distributed pipeline"):
+        slate.eig_count(H, -1.0, 1.0)

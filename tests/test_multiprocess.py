@@ -19,4 +19,6 @@ def test_two_process_distributed_tier():
     proc = subprocess.run([sys.executable, _SCRIPT], capture_output=True,
                           text=True, timeout=580, env=env)
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    if "MULTIPROCESS SKIP" in proc.stdout:
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     assert "MULTIPROCESS PASS" in proc.stdout

