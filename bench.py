@@ -79,6 +79,11 @@ BASELINES = {
                           # ours = f32 LU + emulated-f64 IR to double-class
                           # forward error (gesv_f64ir), flops on the 2n^3/3
                           # dgetrf model
+    "getrf_pp": 9000.0,   # same job/denominator as getrf: CALU with the
+                          # pp panel (Options.lu_panel="pp" — one partial-
+                          # pivot subpanel LU instead of the merge tree) so
+                          # the two panel schemes read as a direct A/B and
+                          # the r5 regression bisection has its second arm
     "svd2s": 150.0,       # dgesvd values n=8192 published-order estimate
                           # (between the n=4096 100 and n=16384 200 rates);
                           # times the SLATE-parity SVD pipeline next to the
@@ -95,13 +100,13 @@ BASELINES = {
 # and tournament paths are slow enough at n=16384 to risk the per-config
 # timeout)
 CONFIGS = ["gemm", "norm", "f64gemm", "potrf", "potrf_la", "gels", "gesvir",
-           "heev", "svd", "getrf", "heev2s", "svd2s"]
+           "heev", "svd", "getrf", "getrf_pp", "heev2s", "svd2s"]
 HEADLINE = "gemm"
 
 # per-config child timeouts: the BASELINE-scale eig/SVD configs and the
-# 64-panel two-level CALU carry minutes of (remote) XLA compile before the
+# 8-panel CALU programs carry minutes of (remote) XLA compile before the
 # first timed call — measured 3 min of compile for the getrf program on CPU
-CONFIG_TIMEOUTS = {"heev": 1300, "svd": 1500, "getrf": 1500,
+CONFIG_TIMEOUTS = {"heev": 1300, "svd": 1500, "getrf": 1500, "getrf_pp": 1500,
                    "potrf_la": 1300, "heev2s": 1800, "svd2s": 1800}
 
 # ---------------------------------------------------------------------------
@@ -281,9 +286,13 @@ def child_potrf(cpu_fallback):
            "unit": "GFLOP/s", "n": n, "sec_per_call": per_iter, **info})
 
 
-def child_getrf(cpu_fallback):
+def child_getrf(cpu_fallback, panel=None):
     """dgetrf (BASELINE config #3; reference test_gesv). Partial-pivot LU via the
-    framework's getrf XLA target (linalg/lu.py: lax.linalg.lu)."""
+    framework's getrf XLA target (linalg/lu.py: lax.linalg.lu).
+
+    ``panel`` pins Options.lu_panel for the first-class A/B configs
+    ("getrf" = tournament, "getrf_pp" = pp); the BENCH_GETRF_PANEL env knob
+    remains for ad-hoc sweeps."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -302,10 +311,17 @@ def child_getrf(cpu_fallback):
     # BENCH_GETRF_NB / BENCH_GETRF_IB override the outer/inner blocking for
     # on-chip sweeps (VERDICT r2 next-step #2 asks for nb in {256,512,1024})
     import os as _os
-    panel = _os.environ.get("BENCH_GETRF_PANEL", "tournament")
+    panel = panel or _os.environ.get("BENCH_GETRF_PANEL", "tournament")
+    # ib defaults to nb (FLAT panel): the round-6 bisection of the r5 getrf
+    # regression (5,493 vs the 6,364-6,795 LKG) landed on the r3 two-level
+    # split — cost_analysis at the scaled shape shows ib=nb/8 costs 2.96x
+    # the bytes accessed of the flat panel for an 11% flop saving
+    # (BENCH_NOTES.md round 6).  The LKG configuration is the flat panel;
+    # two-level stays available as the BENCH_GETRF_IB sweep knob.
+    nb_ = int(_os.environ.get("BENCH_GETRF_NB", 2048))
     opts = {"method_lu": "calu", "lu_panel": panel,
-            "block_size": int(_os.environ.get("BENCH_GETRF_NB", 2048)),
-            "inner_blocking": int(_os.environ.get("BENCH_GETRF_IB", 256))}
+            "block_size": nb_,
+            "inner_blocking": int(_os.environ.get("BENCH_GETRF_IB", nb_))}
 
     def body(i, c, a):
         ap = a + (1e-6 * c[0, 0]) * jnp.eye(n, dtype=a.dtype)
@@ -615,9 +631,13 @@ def child_heev2s(cpu_fallback):
                                      4.0 * n**3 / 3.0, repeats=2)
 
     # phase split (heev.cc:126-212's timer-level-2 analogue): time each
-    # stage once, fetch-forced, so a single chip capture carries the
-    # he2hb / hb2st / sterf breakdown alongside the end-to-end rate
+    # stage once through the shared Timers/phase_report machinery,
+    # fetch-FORCED per stage so the spans are device time, not dispatch —
+    # a single chip capture carries the he2hb / chase / tridiag breakdown
+    # alongside the end-to-end rate, and the same map shape the tester
+    # prints under --timers
     from slate_tpu.linalg.eig import hb2st, he2hb, sterf
+    from slate_tpu.utils.trace import Timers, phase_report
 
     # the phase split costs roughly one more end-to-end run (plus compiles);
     # skip it rather than let the parent kill this child mid-RPC
@@ -625,18 +645,17 @@ def child_heev2s(cpu_fallback):
     if _budget_left() < 1.5 * sec + 60:
         phases["skipped"] = "insufficient budget after rate measurement"
     else:
-        t0 = time.perf_counter()
-        band, Vs, Ts = he2hb(a)
-        float(band.ravel()[0])
-        phases["he2hb_s"] = round(time.perf_counter() - t0, 3)
-        t0 = time.perf_counter()
-        d, e = hb2st(band, want_vectors=False, pipeline=not cpu_fallback)
-        float(d.ravel()[0])
-        phases["hb2st_s"] = round(time.perf_counter() - t0, 3)
-        t0 = time.perf_counter()
-        lam = sterf(d, e)
-        float(lam.ravel()[0])
-        phases["sterf_s"] = round(time.perf_counter() - t0, 3)
+        tm = Timers()
+        with tm.time("he2hb"):
+            band, Vs, Ts = he2hb(a)
+            float(band.ravel()[0])
+        with tm.time("hb2st"):
+            d, e = hb2st(band, want_vectors=False, pipeline=not cpu_fallback)
+            float(d.ravel()[0])
+        with tm.time("sterf"):
+            lam = sterf(d, e)
+            float(lam.ravel()[0])
+        phases = phase_report(tm)
 
     _emit({"metric": f"heev_two_stage_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
@@ -671,19 +690,20 @@ def child_svd2s(cpu_fallback):
                                      8.0 * n**3 / 3.0, repeats=2)
 
     from slate_tpu.linalg.svd import bdsqr, ge2tb, tb2bd
+    from slate_tpu.utils.trace import Timers, phase_report
 
     phases = {}
     if _budget_left() < 1.5 * sec + 60:
         phases["skipped"] = "insufficient budget after rate measurement"
     else:
-        t0 = time.perf_counter()
-        d, e, _, _ = ge2tb(a, chase_pipeline=not cpu_fallback)
-        float(d.ravel()[0])
-        phases["ge2tb_s"] = round(time.perf_counter() - t0, 3)
-        t0 = time.perf_counter()
-        S, _, _ = bdsqr(d, e)
-        float(S.ravel()[0])
-        phases["bdsqr_s"] = round(time.perf_counter() - t0, 3)
+        tm = Timers()
+        with tm.time("ge2tb"):
+            d, e, _, _ = ge2tb(a, chase_pipeline=not cpu_fallback)
+            float(d.ravel()[0])
+        with tm.time("bdsqr"):
+            S, _, _ = bdsqr(d, e)
+            float(S.ravel()[0])
+        phases = phase_report(tm)
 
     _emit({"metric": f"svd_two_stage_f32_n{n}_gflops",
            "value": round(gflops, 1), "unit": "GFLOP/s", "n": n,
@@ -696,6 +716,7 @@ CHILDREN = {
     "gemm": child_gemm,
     "potrf": child_potrf,
     "getrf": child_getrf,
+    "getrf_pp": lambda cpu: child_getrf(cpu, panel="pp"),
     "gels": child_gels,
     "heev": child_heev,
     "svd": child_svd,

@@ -46,11 +46,13 @@ def main():
 
     # --- distributed RBT: butterfly transform + nopiv LU + IR on the mesh
     grid = ProcessGrid(2, 4)
-    Xr, info, it = gesv_rbt_distributed(jnp.asarray(A), jnp.asarray(b),
-                                        grid, depth=2, nb=32)
+    Xr, info, it, via_rbt = gesv_rbt_distributed(jnp.asarray(A),
+                                                 jnp.asarray(b),
+                                                 grid, depth=2, nb=32)
     err = np.linalg.norm(np.asarray(Xr) - x) / np.linalg.norm(x)
     print(f"gesv_rbt_distributed (2x4 grid): rel err {err:.3e} "
-          f"(info={int(info)}, iters={int(it)})")
+          f"(info={int(info)}, iters={int(it)}, "
+          f"via {'rbt' if via_rbt else 'partialpiv fallback'})")
     print("ex17 OK")
 
 

@@ -39,7 +39,7 @@ from ..core.matrix import (BaseMatrix, HermitianMatrix, SymmetricMatrix, as_arra
 from ..core.types import MethodEig, Norm, Options, Target, Uplo
 from ..ops import norms as norm_ops
 from ..robust import inject
-from ..utils.trace import Timers, trace_block
+from ..utils.trace import Timers, record_phases, trace_block
 from .chol import _full_spd, potrf
 
 
@@ -151,6 +151,7 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
         with timers.time("heev::rescale"):
             lam = lam * factor
     heev.timers = timers  # exposed like the reference's driver timers
+    record_phases("heev", timers)  # --timer-level-2 map (trace.last_phases)
     return (lam, z) if want_vectors else (lam, None)
 
 

@@ -238,6 +238,10 @@ def getrf(A, opts=None):
     (perm = identity), RBT is reserved for gesv_rbt.
     """
     opts = Options.make(opts)
+    # validated up front, on EVERY path: a typo'd lu_panel must raise, never
+    # silently run the other panel scheme (parity-audit behavior contract)
+    slate_assert(opts.lu_panel in ("tournament", "pp"),
+                 f"lu_panel must be 'tournament' or 'pp', got {opts.lu_panel!r}")
     method = opts.method_lu
     if method == MethodLU.Auto:
         method = MethodLU.PartialPiv
@@ -257,10 +261,12 @@ def getrf(A, opts=None):
         # construction-time distribution the same way).  Wide inputs factor the
         # leading square block + one sharded trsm; tall inputs ride the 1-D
         # TSLU (O(m n²/P); the round-2 square embedding and its m <= 2n
-        # caller guard are gone).
+        # caller guard are gone).  Options.lu_panel reaches the mesh panel
+        # too ("pp" = gathered partial-pivot panel, pivot.partialpiv_piv).
         from ..parallel import getrf_distributed
 
-        lu_, perm, info = getrf_distributed(a_chk, grid, nb=opts.block_size)
+        lu_, perm, info = getrf_distributed(a_chk, grid, nb=opts.block_size,
+                                            lu_panel=opts.lu_panel)
         write_back(A, lu_)
         return lu_, perm, info
 

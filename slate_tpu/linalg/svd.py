@@ -24,7 +24,7 @@ from ..core.exceptions import slate_assert
 from ..core.matrix import BaseMatrix, as_array
 from ..core.types import MethodSVD, Options
 from ..robust import inject
-from ..utils.trace import Timers, trace_block
+from ..utils.trace import Timers, record_phases, trace_block
 from .eig import _safe_scale
 from .qr import geqrf, unmqr
 
@@ -97,6 +97,7 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
                 U = VT = None
             Sv = Sv * factor
         svd.timers = timers
+        record_phases("svd", timers)
         return Sv, (U if want_u else None), (VT if want_vt else None)
     with trace_block("svd", m=m, n=n):
         with timers.time("svd::scale"):
@@ -136,6 +137,7 @@ def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
                 VT = jnp.conj(jnp.swapaxes(V, -1, -2))
         S = S * factor
     svd.timers = timers
+    record_phases("svd", timers)
     return S, (U if want_u else None), (VT if want_vt else None)
 
 

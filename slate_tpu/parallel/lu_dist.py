@@ -52,7 +52,7 @@ from ..utils.trace import trace_event
 from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 from .pivot import (exchange_rows as _exchange_rows,
-                    step_permutation, tournament_piv)
+                    select_pivots, step_permutation)
 
 
 def _panel_tail(A_loc, pan, LUkk, k0, grow, gcol, pi, qi, mr, mc, nb):
@@ -113,8 +113,11 @@ def _lu_diag_info(A_loc, grow, gcol, npad):
 
 
 @lru_cache(maxsize=32)
-def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
-    """Build the jitted shard_map tournament-LU over an npad×npad matrix."""
+def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str,
+                   lu_panel: str = "tournament"):
+    """Build the jitted shard_map tournament-LU over an npad×npad matrix.
+    ``lu_panel`` selects the panel pivot scheme (Options.lu_panel: CALU
+    tournament rounds or one gathered partial-pivot LU, pivot.py)."""
     p, q = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
     mr, mc = npad // p, npad // q          # local shard shape
     nt = npad // nb                        # panel count (static)
@@ -140,9 +143,9 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
             k0 = (k * nb).astype(jnp.int32) if hasattr(k, "astype") else k * nb
             pan = extract_panel(A_loc, k0)
 
-            # ---- tournament rounds + ipiv-compatible step permutation
+            # ---- panel pivot selection + ipiv-compatible step permutation
             # (shared machinery, pivot.py; internal_getrf_tntpiv analogue)
-            piv = tournament_piv(pan, grow, k0, nb, p, ROW_AXIS)
+            piv = select_pivots(lu_panel, pan, grow, k0, nb, p, ROW_AXIS)
             stepperm = step_permutation(piv, k0, npad, nb)
             perm = perm[stepperm]
 
@@ -196,7 +199,8 @@ def _getrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
 
 
 @lru_cache(maxsize=32)
-def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
+def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str,
+                   lu_panel: str = "tournament"):
     """Jitted 1-D TSLU over an mpad×npc tall matrix: rows block-sharded over
     the *flattened* mesh (every device owns all columns), tournament panels
     over the flat axis, trailing updates as fully local MXU gemms.
@@ -224,10 +228,10 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
             A_loc, perm = carry
             k0 = (k * nb).astype(jnp.int32) if hasattr(k, "astype") else k * nb
 
-            # ---- tournament rounds + ipiv-compatible step permutation
+            # ---- panel pivot selection + ipiv-compatible step permutation
             # (shared machinery, pivot.py)
             pan = lax.dynamic_slice(A_loc, (jnp.int32(0), k0), (mr, nb))
-            piv = tournament_piv(pan, grow, k0, nb, nprocs, AX)
+            piv = select_pivots(lu_panel, pan, grow, k0, nb, nprocs, AX)
             stepperm = step_permutation(piv, k0, mpad, nb)
             perm = perm[stepperm]
 
@@ -308,7 +312,8 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
-def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
+def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
+                           lu_panel: str = "tournament"):
     """1-D TSLU for tall matrices (m > n) over the flattened mesh.
 
     Returns ``(LU, perm, info)`` with ``A[perm] = L @ U`` in O(m n²/P) work —
@@ -319,6 +324,8 @@ def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """
     m, n = A.shape[-2:]
     slate_assert(m >= n, "getrf_tall_distributed expects m >= n")
+    slate_assert(lu_panel in ("tournament", "pp"),
+                 f"lu_panel must be 'tournament' or 'pp', got {lu_panel!r}")
     nb = max(1, min(nb, n))
     unit = nb * grid.p * grid.q
     npc = ceil_mult(n, nb)
@@ -335,7 +342,8 @@ def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     mesh = grid.mesh
     Ap = jax.device_put(Ap, jax.sharding.NamedSharding(
         mesh, P((ROW_AXIS, COL_AXIS), None)))
-    LU, perm, info = _getrf_tall_fn(mesh, mpad, npc, nb, str(Ap.dtype))(Ap)
+    LU, perm, info = _getrf_tall_fn(mesh, mpad, npc, nb, str(Ap.dtype),
+                                    lu_panel)(Ap)
     if mpad > m:
         # pad columns carry their unit pivot on a PAD row, so each pad column
         # deterministically swaps one pad row into the head — positions
@@ -367,12 +375,19 @@ def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     return LU[:m, :n], perm, info
 
 
-def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
+def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
+                      lu_panel: str = "tournament"):
     """Distributed tournament-pivoted LU over the process grid.
 
     Returns ``(LU, perm, info)`` with ``A[perm] = L @ U`` (L unit-lower, U
     upper, packed into one sharded array) — the distributed form of
     ``linalg.lu.getrf_tntpiv`` and the analogue of ``src/getrf_tntpiv.cc``.
+
+    ``lu_panel`` (Options.lu_panel) selects panel pivoting: "tournament"
+    (CALU candidate rounds, the communication-avoiding default) or "pp"
+    (one gathered partial-pivot panel LU — exact LAPACK selection at
+    O(m·nb) gather bytes per panel; the first-class A/B of the single-chip
+    ``_getrf_tntpiv_fn`` schemes).
 
     Tall inputs (m > n) route to ``getrf_tall_distributed`` — 1-D TSLU over
     the flattened mesh with O(m n²/P) work (round 2's O(m³) square embedding
@@ -386,12 +401,15 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """
     m, n = A.shape[-2:]
     slate_assert(A.ndim == 2, "getrf_distributed expects a 2-D matrix")
+    slate_assert(lu_panel in ("tournament", "pp"),
+                 f"lu_panel must be 'tournament' or 'pp', got {lu_panel!r}")
     if m > n:
-        return getrf_tall_distributed(A, grid, nb=nb)
+        return getrf_tall_distributed(A, grid, nb=nb, lu_panel=lu_panel)
     if m < n:
         from .solvers import trsm_distributed
 
-        LU1, perm, info = getrf_distributed(A[:, :m], grid, nb=nb)
+        LU1, perm, info = getrf_distributed(A[:, :m], grid, nb=nb,
+                                            lu_panel=lu_panel)
         L = jnp.tril(LU1, -1) + jnp.eye(m, dtype=LU1.dtype)
         U2 = trsm_distributed(L, jnp.take(A[:, m:], perm, axis=0), grid,
                               lower=True, conj_trans=False)
@@ -412,7 +430,7 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
         Ap = A
     Ap = jax.device_put(Ap, grid.spec())
     LU, perm, info = _getrf_dist_fn(grid.mesh, npad, min(nb, npad),
-                                    str(Ap.dtype))(Ap)
+                                    str(Ap.dtype), lu_panel)(Ap)
     if npad > m:
         # pad rows never win a tournament against real rows (their entries in
         # real columns are zero) — except when a trailing block is exactly
@@ -456,7 +474,7 @@ def getrs_distributed(LU: jax.Array, perm: jax.Array, B: jax.Array,
 
 
 def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
-                     nb: int = 256):
+                     nb: int = 256, lu_panel: str = "tournament"):
     """Distributed general solve A X = B (src/gesv.cc = getrf + getrs).
 
     Runs under the failed-shard guard (robust.guard_shards): when a fault
@@ -470,7 +488,7 @@ def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
 
     def run():
         LU, perm, info = getrf_distributed(inject("gesv_distributed", A),
-                                           grid, nb=nb)
+                                           grid, nb=nb, lu_panel=lu_panel)
         state["info"] = info
         return getrs_distributed(LU, perm, B, grid)
 

@@ -37,6 +37,42 @@ def tournament_piv(W, grow, k0, nb: int, nprocs: int, ax):
     return jnp.where(piv >= k0, piv, k0 + jnp.arange(nb, dtype=jnp.int32))
 
 
+def partialpiv_piv(W, grow, k0, nb: int, nprocs: int, ax):
+    """Classic partial-pivot panel selection (``lu_panel="pp"``): ONE
+    all-gather of the full panel, ONE partial-pivot LU — the distributed form
+    of the pp A/B in ``linalg.lu._getrf_tntpiv_fn``.
+
+    Selection quality is exact LAPACK partial pivoting (the tournament is an
+    approximation); the trade is the gather volume — O(m·nb) panel bytes per
+    step vs the tournament's O(P·nb²) candidate bytes — against the
+    tournament's two sequential batched-LU rounds.  Same contract as
+    ``tournament_piv``: nb winning global rows in pivot order, identity
+    fallback for degenerate slots.
+    """
+    cand_ok = grow >= k0
+    Wm = jnp.where(cand_ok[:, None], W, jnp.zeros_like(W))
+    C = lax.all_gather(Wm, ax).reshape(nprocs * W.shape[0], nb)
+    I = lax.all_gather(jnp.where(cand_ok, grow, jnp.int32(-1)),
+                       ax).reshape(nprocs * W.shape[0])
+    _, _, perm = lax.linalg.lu(C)
+    piv = I[perm[:nb]]
+    return jnp.where(piv >= k0, piv, k0 + jnp.arange(nb, dtype=jnp.int32))
+
+
+_PANEL_SCHEMES = {"tournament": tournament_piv, "pp": partialpiv_piv}
+
+
+def select_pivots(scheme: str, W, grow, k0, nb: int, nprocs: int, ax):
+    """Panel pivot-selection dispatch shared by the distributed LU variants:
+    ``scheme`` is ``Options.lu_panel`` ("tournament" | "pp").  Unknown
+    schemes raise (static, trace-time) — never a silent tournament fallback."""
+    fn = _PANEL_SCHEMES.get(scheme)
+    if fn is None:
+        raise ValueError(f"lu_panel must be one of {sorted(_PANEL_SCHEMES)}, "
+                         f"got {scheme!r}")
+    return fn(W, grow, k0, nb, nprocs, ax)
+
+
 def step_permutation(piv, k0, npad: int, nb: int):
     """Replay the nb sequential interchanges ``position k0+i <-> row piv[i]``
     into a length-npad permutation (new position -> old position) — the

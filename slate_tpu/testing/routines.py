@@ -38,6 +38,18 @@ def _routine(name: str, category: str):
 # ---------------------------------------------------------------------------
 # helpers
 
+def _phases(routine: str) -> dict:
+    """Driver phase map for the tester row (--timer-level-2 analogue): the
+    he2hb / chase / tridiag / back-transform attribution recorded by the last
+    heev/svd call (utils.trace.record_phases).  Host-side spans — on an async
+    backend they attribute dispatch, not device time; the stage-level rows
+    (sterf/he2hb/hb2st) are the forced per-phase sweep surface."""
+    from slate_tpu.utils.trace import last_phases, phase_report
+
+    t = last_phases(routine)
+    return phase_report(t, min_frac=0.02) if t else {}
+
+
 def _grid(p):
     """ProcessGrid for a grid-swept row (tester p x q dimension, like the
     reference tester's --p/--q sweep) or None for single-device rows."""
@@ -345,18 +357,40 @@ def run_gesv(p, slate):
 
 @_routine("gesv_mixed", "lu")
 def run_gesv_mixed(p, slate):
-    """Mixed-precision IR (meaningful for d/z types)."""
+    """Mixed-precision IR (src/gesv_mixed.cc: low-precision factor + IR).
+
+    The mixed path only exists where a lower precision exists (d->s, z->c),
+    so an s/c sweep row PROMOTES to its d/z counterpart (noted in the row)
+    instead of skipping outright — every sweep line exercises the actual
+    factor-low/refine-high pipeline.  The IR iteration count is recorded in
+    the tester row (details["ir_iters"], the reference tester's iters
+    column)."""
+    promoted = {np.dtype(np.float32): np.float64,
+                np.dtype(np.complex64): np.complex128}.get(np.dtype(p["dtype"]))
+    if promoted is not None:
+        # scoped x64 (jax.experimental.enable_x64) keeps the promotion local
+        # to this row — the rest of the sweep stays in the caller's mode
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            out = _gesv_mixed_body(dict(p, dtype=promoted), slate)
+        out.setdefault("details", {})["promoted"] = \
+            f"s/c -> {np.dtype(promoted).char}"
+        return out
+    return _gesv_mixed_body(p, slate)
+
+
+def _gesv_mixed_body(p, slate):
     n = p["n"]
-    if np.dtype(p["dtype"]) in (np.float32, np.complex64):
-        return {"status": "skipped", "message": "no lower precision for s/c",
-                "error": None, "time_s": None, "gflops": None, "ref_time_s": None}
     A = _gen(p["kind"], n, n, p) + n * np.eye(n, dtype=p["dtype"])
     b = _gen("randn", n, 1, p)
     (X, perm, info, iters), t = time_call(lambda: slate.gesv_mixed(A.copy(), b.copy()),
                                           repeat=p["repeat"])
     x = np.asarray(X)
     err = _rel(np.linalg.norm(A @ x - b), np.linalg.norm(A) * np.linalg.norm(x))
-    return _result(p, err, 2 * n ** 3 / 3, t)
+    out = _result(p, err, 2 * n ** 3 / 3, t)
+    out["details"] = {"ir_iters": int(iters)}
+    return out
 
 
 @_routine("gesv_rbt", "lu")
@@ -531,7 +565,9 @@ def run_heev(p, slate):
     lam, Z = np.asarray(lam), np.asarray(Z)
     err1 = _rel(np.linalg.norm(A @ Z - Z * lam[None, :]), np.linalg.norm(A))
     err2 = np.linalg.norm(Z.conj().T @ Z - np.eye(n)) / n
-    return _result(p, max(err1, err2), 9.0 * n ** 3, t)
+    out = _result(p, max(err1, err2), 9.0 * n ** 3, t)
+    out["details"] = {"phases": _phases("heev")}
+    return out
 
 
 @_routine("heevx", "eig")
@@ -624,6 +660,77 @@ def run_steqr(p, slate):
                    tol_mult=max(1.0, n ** 0.5) / 10.0)
 
 
+@_routine("sterf", "eig")
+def run_sterf(p, slate):
+    """Stage-level tester for the tridiagonal VALUES solver (test_sterf.cc):
+    eigenvalues of T(d, e) vs the f64 dense reference — the sweep surface
+    that localizes a two-stage regression to the tridiag phase."""
+    n = p["n"]
+    rng = np.random.default_rng(p["seed"])
+    rdt = np.dtype(p["dtype"]).char.lower()     # sterf is real-only, like LAPACK
+    d = rng.standard_normal(n).astype(rdt)
+    e = rng.standard_normal(n - 1).astype(rdt)
+    from slate_tpu.linalg.eig import sterf
+
+    lam, t = time_call(lambda: sterf(d, e), repeat=p["repeat"])
+    lam = np.sort(np.asarray(lam, np.float64))
+    T = np.diag(d.astype(np.float64)) + np.diag(e.astype(np.float64), 1) \
+        + np.diag(e.astype(np.float64), -1)
+    ref = np.linalg.eigvalsh(T)
+    err = _rel(np.max(np.abs(lam - ref)), max(np.max(np.abs(ref)), 1e-30))
+    # O(n^2) bisection work model (PWK/sterf class)
+    return _result(p, err, 2.0 * n * n, t)
+
+
+@_routine("he2hb", "eig")
+def run_he2hb(p, slate):
+    """Stage-level tester for the full->band reduction (test_he2hb.cc):
+    ‖Qᴴ A Q − B‖/‖A‖ via the stacked block reflectors, plus band shape."""
+    n = p["n"]
+    A = _herm(n, p)
+    from slate_tpu.linalg.eig import default_band_nb, he2hb, he2hb_q
+
+    nb = default_band_nb(n, None)
+    (band, Vs, Ts), t = time_call(lambda: he2hb(A.copy(), nb=nb),
+                                  repeat=p["repeat"])
+    band, Q = np.asarray(band), np.asarray(he2hb_q(Vs, Ts))
+    err1 = _rel(np.linalg.norm(Q.conj().T @ A @ Q - band), np.linalg.norm(A))
+    err2 = np.linalg.norm(Q.conj().T @ Q - np.eye(n)) / n
+    r, c = np.nonzero(np.abs(band) > 0)
+    bw_ok = (len(r) == 0) or (np.max(np.abs(r - c)) <= nb)
+    out = _result(p, max(err1, err2), 4.0 * n ** 3 / 3.0, t, tol_mult=4)
+    if not bw_ok:
+        out["status"], out["message"] = "FAILED", f"bandwidth > nb={nb}"
+    out["details"] = {"nb": nb}
+    return out
+
+
+@_routine("hb2st", "eig")
+def run_hb2st(p, slate):
+    """Stage-level tester for the band->tridiagonal chase (test_hb2st.cc):
+    ‖B Q2 − Q2 T‖/‖B‖ + orthogonality of the accumulated Q2."""
+    n = p["n"]
+    kd = max(2, min(8, n // 8))
+    A = _herm(n, p)
+    r_idx = np.arange(n)
+    band = np.where(np.abs(r_idx[:, None] - r_idx[None, :]) <= kd, A, 0)
+    from slate_tpu.linalg.eig import hb2st
+
+    (d, e, Q2), t = time_call(
+        lambda: hb2st(band.copy(), kd=kd, want_vectors=True),
+        repeat=p["repeat"])
+    d, e, Q2 = np.asarray(d), np.asarray(e), np.asarray(Q2)
+    T = np.diag(d.astype(np.float64)) + np.diag(e.astype(np.float64), 1) \
+        + np.diag(e.astype(np.float64), -1)
+    err1 = _rel(np.linalg.norm(band @ Q2 - Q2 @ T.astype(Q2.dtype)),
+                np.linalg.norm(band))
+    err2 = np.linalg.norm(Q2.conj().T @ Q2 - np.eye(n)) / n
+    # chase work model: O(n^2 kd) reflector flops + O(n^3)-class Q2 gemms
+    out = _result(p, max(err1, err2), 2.0 * n ** 3, t, tol_mult=4)
+    out["details"] = {"kd": kd}
+    return out
+
+
 @_routine("hegv", "eig")
 def run_hegv(p, slate):
     n = p["n"]
@@ -650,7 +757,9 @@ def run_svd(p, slate):
     err1 = _rel(np.linalg.norm(A - (U[:, :k] * S[None, :k]) @ VT[:k]),
                 np.linalg.norm(A))
     err2 = np.linalg.norm(U.conj().T @ U - np.eye(U.shape[1])) / k
-    return _result(p, max(err1, err2), 4.0 * m * n * min(m, n), t)
+    out = _result(p, max(err1, err2), 4.0 * m * n * min(m, n), t)
+    out["details"] = {"phases": _phases("svd")}
+    return out
 
 
 @_routine("gecondest", "condest")

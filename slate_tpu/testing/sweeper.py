@@ -71,6 +71,9 @@ class TestResult:
     ref_time_s: Optional[float] = None
     status: str = "pass"           # pass | FAILED | error | skipped
     message: str = ""
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # side-channel columns (the reference's --timer-level 2 phase map, IR
+    # iteration counts, ...): never gates pass/fail, printed by --timers
 
     @property
     def ok(self) -> bool:

@@ -144,3 +144,45 @@ class Timers(dict):
             yield
         finally:
             self[key] = self.get(key, 0.0) + (time.perf_counter() - t)
+
+
+# ---------------------------------------------------------------------------
+# per-phase perf attribution (the reference tester's --timer-level 2 map,
+# heev.cc:126-212: "timers[...]" rows printed per driver phase)
+# ---------------------------------------------------------------------------
+
+_phase_maps: Dict[str, Dict[str, float]] = {}
+
+
+def record_phases(routine: str, timers: "Timers | Dict[str, float]") -> None:
+    """Publish a driver's phase map (called by heev/svd at return, like the
+    reference drivers filling ``timers[]``).  The tester and bench read it
+    back via :func:`last_phases` so a below-baseline number localizes to a
+    phase (he2hb / chase / tridiag / back-transform) instead of a driver."""
+    with _events_lock:
+        _phase_maps[routine] = dict(timers)
+
+
+def last_phases(routine: str) -> Dict[str, float]:
+    """Most recent phase map for ``routine`` ({} when it has not run)."""
+    with _events_lock:
+        return dict(_phase_maps.get(routine, {}))
+
+
+def phase_report(timers: "Timers | Dict[str, float]",
+                 min_frac: float = 0.0) -> Dict[str, Any]:
+    """Render a Timers map as the --timer-level-2 style attribution table:
+    ``{phase: {"s": seconds, "pct": share}}`` sorted hottest-first, plus
+    ``"total_s"``.  Phase spans are host-side wall time — honest device
+    attribution requires each phase to be forced (fetched) before its span
+    closes, which the bench children and the tester's stage rows do.
+    ``min_frac`` drops phases below that share (compact bench lines)."""
+    items = [(k, float(v)) for k, v in timers.items()]
+    total = sum(v for _, v in items)
+    out: Dict[str, Any] = {"total_s": round(total, 6)}
+    for k, v in sorted(items, key=lambda kv: -kv[1]):
+        frac = v / total if total > 0 else 0.0
+        if frac < min_frac:
+            continue
+        out[k] = {"s": round(v, 6), "pct": round(100.0 * frac, 1)}
+    return out

@@ -110,6 +110,19 @@ def test_getrf_tntpiv_pp_matches_lapack_pivots(rng):
     assert np.allclose(np.asarray(lu_arr), lu_ref, atol=1e-12)
 
 
+def test_getrf_bad_lu_panel_raises(rng):
+    """lu_panel is validated on EVERY getrf path, not silently ignored
+    (parity-audit behavior contract) — including the default PartialPiv
+    path, where the knob is inert but a typo must still surface."""
+    from slate_tpu.core.exceptions import SlateError
+
+    a = _gen(rng, 16, 16)
+    with pytest.raises(SlateError):
+        linalg.getrf(a, {"method_lu": "calu", "lu_panel": "bogus"})
+    with pytest.raises(SlateError):
+        linalg.getrf(a, {"lu_panel": "bogus"})      # default method path
+
+
 @pytest.mark.parametrize("method", ["partialpiv", "calu"])
 def test_gesv(rng, method):
     n, nrhs = 24, 3

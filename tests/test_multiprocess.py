@@ -20,5 +20,10 @@ def test_two_process_distributed_tier():
                           text=True, timeout=580, env=env)
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     if "MULTIPROCESS SKIP" in proc.stdout:
-        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+        # environment gate, not a feature hole: the two-process tier needs a
+        # jaxlib whose CPU backend ships cross-process collectives (or a real
+        # multi-host TPU slice); CI runs the identical tier as its own step
+        # (tools/run_multiprocess.py) where the capability probe passes
+        pytest.skip("environment: jaxlib CPU backend lacks multiprocess "
+                    "collectives (tier runs where the probe passes)")
     assert "MULTIPROCESS PASS" in proc.stdout

@@ -107,3 +107,70 @@ class TestDispatchIntegration:
         assert not norms._pallas_ok(jnp.asarray(a))
         np.testing.assert_allclose(float(norms.genorm("fro", jnp.asarray(a))),
                                    np.linalg.norm(a), rtol=1e-5)
+
+
+class TestKernelPlan:
+    """Committable kernel-shape evidence (the compiled-HLO analogue a capture
+    window can confirm on chip): the streaming reductions must stay (8, 128)
+    tile-aligned and read HBM exactly once."""
+
+    def test_bench_shape_single_pass(self):
+        """n=16384 f32 — the norm bench config.  No padding at all, one
+        streaming pass, native-tile output block."""
+        plan = pn.kernel_plan(16384, 16384, jnp.float32, kind="col")
+        assert plan["padded_shape"] == (16384, 16384)
+        assert plan["single_pass"]
+        assert plan["bytes_in"] == 16384 * 16384 * 4
+        assert plan["sublane_aligned"] and plan["lane_aligned"]
+        assert plan["out_block"][0] == pn._SUBLANE      # full vreg tile, not
+        #                                                a 1-sublane row
+        assert plan["in_block"][1] % pn._LANE == 0
+
+    def test_row_plan_lane_folded(self):
+        plan = pn.kernel_plan(16384, 16384, jnp.float32, kind="row")
+        assert plan["single_pass"]
+        assert plan["out_block"] == (plan["in_block"][0], pn._LANE)
+        assert plan["sublane_aligned"] and plan["lane_aligned"]
+
+    def test_ragged_shapes_stay_aligned(self):
+        """Odd shapes pad but never break tile alignment, and padding stays
+        bounded (one block per dim)."""
+        for m, n in [(300, 200), (5, 3), (257, 131), (8191, 8193)]:
+            for kind in ("col", "row"):
+                plan = pn.kernel_plan(m, n, jnp.float32, kind=kind)
+                assert plan["single_pass"], (m, n, kind)
+                assert plan["sublane_aligned"] and plan["lane_aligned"]
+                pm, pnn = plan["padded_shape"]
+                assert pm - m < plan["in_block"][0] + pn._SUBLANE
+                assert pnn - n <= max(plan["in_block"][1], pn._LANE)
+
+    def test_plan_matches_traced_pallas_call(self):
+        """kernel_plan (the static model) vs traced_plan (the ACTUAL
+        pallas_call) — the non-tautological half of the evidence: a kernel
+        change that alters grid, block shapes, padding, or makes the input
+        index_map revisit blocks (multi-pass traffic) fails here even though
+        the static model cannot see it."""
+        for (m, n), kind in [((300, 200), "col"), ((300, 200), "row"),
+                             ((1024, 4096), "col")]:
+            plan = pn.kernel_plan(m, n, jnp.float32, kind=kind)
+            traced = pn.traced_plan(m, n, jnp.float32, kind=kind)
+            assert traced["grid"] == plan["grid"], (kind, traced["grid"])
+            assert tuple(plan["in_block"]) in traced["blocks"], (kind, traced)
+            assert tuple(plan["out_block"]) in traced["blocks"], (kind, traced)
+            # padded operand shape reaches the kernel (the pad really ran)
+            assert tuple(plan["padded_shape"]) in traced["operand_shapes"]
+            # one streaming pass, measured on the real index_map
+            assert traced["single_pass"], (kind, traced)
+
+    def test_col_partials_are_sublane_tiles(self, a):
+        """The (8, pn) partial layout is numerically exact: folding the 8
+        sublane partials reproduces the full column reduction (row r
+        contributes to sublane r % 8 — the alignment invariant)."""
+        x = jnp.asarray(a)
+        np.testing.assert_allclose(npa(pn.col_reduce(x, op="sum")),
+                                   np.abs(a).sum(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(npa(pn.col_reduce(x, op="sumsq")),
+                                   (a.astype(np.float64) ** 2).sum(axis=0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npa(pn.col_reduce(x, op="max")),
+                                   np.abs(a).max(axis=0), rtol=1e-6)

@@ -20,3 +20,17 @@ def test_parity_audit_passes():
         capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert "MISSING" not in out.stdout
+
+
+def test_behavior_checks_pass():
+    """The behavior half of the audit (method routing, lu_panel, option
+    plumbing) needs no reference checkout — it must pass everywhere
+    (VERDICT r5 weak #6: the name audit alone would pass a stub)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parity_audit
+    finally:
+        sys.path.pop(0)
+    fails, nchecks = parity_audit.behavior_checks()
+    assert not fails, fails
+    assert nchecks >= 6       # the audit actually ran its check blocks

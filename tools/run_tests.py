@@ -73,6 +73,9 @@ def main(argv=None) -> int:
     ap.add_argument("--tall", action="store_true", help="tall shapes m = 2n")
     ap.add_argument("--wide", action="store_true", help="wide shapes n = 2m")
     ap.add_argument("--ref", action="store_true", help="time numpy reference too")
+    ap.add_argument("--timers", action="store_true",
+                    help="print per-phase timer maps under eig/svd rows (the "
+                         "reference tester's --timer-level 2)")
     ap.add_argument("--xml", default=None, help="write JUnit XML here")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--grid", default=None, metavar="PxQ",
@@ -104,9 +107,25 @@ def main(argv=None) -> int:
         err = r.error if r.error is not None else float("nan")
         gf = f"{r.gflops:8.1f}" if r.gflops is not None else "       -"
         tm = f"{r.time_s:8.4f}" if r.time_s is not None else "       -"
+        extra = ""
+        iters = (r.details or {}).get("ir_iters")
+        if iters is not None:
+            extra = f" iters={iters}"
         print(f"{r.routine:16s} {r.params.get('dtype')} "
               f"{r.params['m']:5d}x{r.params['n']:<5d} nb={r.params['nb']:<4d} "
-              f"t={tm}s gf={gf} err={err:.2e} {status} {r.message}", flush=True)
+              f"t={tm}s gf={gf} err={err:.2e} {status}{extra} {r.message}",
+              flush=True)
+        phases = (r.details or {}).get("phases")
+        if args.timers and phases:
+            # --timer-level-2 analogue: one indented line per phase, hottest
+            # first (phase_report already ordered them)
+            total = phases.get("total_s", 0.0)
+            for k, v in phases.items():
+                if k == "total_s":
+                    continue
+                print(f"    {k:<24s} {v['s']:9.4f}s {v['pct']:5.1f}%",
+                      flush=True)
+            print(f"    {'total':<24s} {total:9.4f}s", flush=True)
 
     t0 = time.time()
     grid = (tuple(int(x) for x in args.grid.lower().split("x"))

@@ -1,6 +1,9 @@
 #!/bin/bash
-# Tunnel-recovery watcher v2 (round 5): single tunnel owner; captures the
-# outstanding bench configs into BENCH_LKG.json in VERDICT-r4 priority order.
+# Tunnel-recovery watcher v3 (round 6): single tunnel owner; captures the
+# outstanding bench configs into BENCH_LKG.json in ISSUE-r6 priority order —
+# staged-but-unmeasured hot-path work first (lane-aligned norms; potrf Tiled
+# vs lookahead pipeline in ONE window; getrf tournament-vs-pp A/B in ONE
+# window), then the coverage/refresh tail.
 #
 # Changes vs v1 after the 09:20 wedge forensics:
 # - every group (and every sweep child) is gated by its OWN probe, so a
@@ -56,30 +59,36 @@ run_child() {  # $1 step name, $2 timeout, $3 config, rest = env pairs
 # one outer loop so a group whose tunnel-wait expired gets another chance
 for pass in 1 2 3; do
   log "pass $pass"
-  # (a) VERDICT #2/#3: the potrf-closer family + the norm fix, all fast
-  run_group g_norm_potrf "norm,potrf" 1800 2000
+  # (a) STAGED-FIRST (ISSUE r6): the two decisions that need same-window
+  #     evidence land before anything else burns tunnel budget —
+  #     * norm: the lane-aligned (8,128) Pallas rewrite vs its 0.255x LKG;
+  #     * potrf vs potrf_la: Tiled vs the explicit lookahead pipeline at the
+  #       SAME n=16384 job in the SAME window (potrf.cc:136-177 decision)
+  run_group g_norm_potrf_la "norm,potrf,potrf_la" 2700 2900
+  run_child s_norm_xla 900 norm BENCH_NORM_IMPL=xla
+  # (b) the getrf regression A/B: tournament vs pp panel back-to-back in one
+  #     window (bisection arm 2 — BENCH_NOTES.md round-6 section)
+  run_group g_getrf_ab "getrf,getrf_pp" 3000 3200
+  # (c) potrf closers
   run_child s_potrf_nb1024 900 potrf BENCH_POTRF_NB=1024
   run_child s_potrf_nb4096 900 potrf BENCH_POTRF_NB=4096
   run_child s_potrf_inv 900 potrf BENCH_POTRF_INVTRSM=1
-  run_child s_norm_xla 900 norm BENCH_NORM_IMPL=xla
-  # (b) round-4 additions that have never touched the chip
-  run_group g_la_f64_ir "potrf_la,f64gemm,gesvir" 2400 2600
   run_child s_potrf_la_nb1024 1000 potrf_la BENCH_POTRF_LA_NB=1024
-  # (c) two-stage pipelines: a quick n=4096 capture first (lands evidence
+  # (d) round-4 additions that have never touched the chip
+  run_group g_f64_ir "f64gemm,gesvir" 1800 2000
+  # (e) two-stage pipelines: a quick n=4096 capture first (lands evidence
   #     in a short tunnel window), then the n=8192 configs with phase splits
   run_child s_heev2s_n4096 1200 heev2s BENCH_HEEV2S_N=4096
   run_child s_svd2s_n4096 1200 svd2s BENCH_SVD2S_N=4096
   run_group g_twostage "heev2s,svd2s" 4000 4300
-  # (d) BASELINE-scale heev/svd (budget-truncating children land a number)
+  # (f) BASELINE-scale heev/svd (budget-truncating children land a number)
   run_group g_heev_svd "heev,svd" 3200 3400
-  # (e) getrf blocking sweeps (reconnect with the round-2 6.8 TF/s evidence);
-  #     the pp-panel A/B targets the tournament's sequential-depth hypothesis
-  run_child s_getrf_pp 1500 getrf BENCH_GETRF_PANEL=pp
+  # (g) getrf blocking sweeps (reconnect with the round-2 6.8 TF/s evidence)
   run_child s_getrf_nb2048_ib512 1500 getrf BENCH_GETRF_NB=2048 BENCH_GETRF_IB=512
   run_child s_getrf_nb2048_ib128 1500 getrf BENCH_GETRF_NB=2048 BENCH_GETRF_IB=128
   run_child s_getrf_nb1024_ib256 1500 getrf BENCH_GETRF_NB=1024 BENCH_GETRF_IB=256
   run_child s_getrf_nb4096_ib512 1500 getrf BENCH_GETRF_NB=4096 BENCH_GETRF_IB=512
-  # (f) refresh the round-3 captures that already have good cached numbers
+  # (h) refresh the round-3 captures that already have good cached numbers
   run_group g_refresh "gemm,gels" 1500 1700
   # (g) potrf profile trace for the lookahead analysis
   if ! done_step s_profile && probe_ok; then
