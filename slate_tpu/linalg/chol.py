@@ -43,6 +43,7 @@ from ..ops import blas3
 from ..robust import (RetryPolicy, Rung, SolveReport, first_bad_index, inject,
                       run_ladder)
 from ..utils.trace import trace_block, trace_event
+from ..obs import instrument
 
 
 def _full_spd(A, uplo) -> jax.Array:
@@ -203,6 +204,7 @@ def _potrf_tiled_fn(n: int, nb: int, dtype_str: str, inv_trsm: bool = False):
     return jax.jit(fn)
 
 
+@instrument
 def potrf(A, opts=None, uplo=None):
     """Cholesky factorization A = L L^H (src/potrf.cc:262-281 dispatch shape).
 
@@ -269,6 +271,7 @@ def potrs(A, B, opts=None, uplo=None):
     return write_back(B, x)
 
 
+@instrument
 def posv(A, B, opts=None, uplo=None):
     """Solve SPD system A X = B (src/posv.cc = potrf + potrs).
 
@@ -342,6 +345,7 @@ def _write_triangle(A, tri_result, uplo: Uplo):
     return tri_result
 
 
+@instrument
 def potri(A, opts=None, uplo=None):
     """SPD inverse from the Cholesky factor: A^{-1} = L^{-H} L^{-1}
     (src/potri.cc = trtri + trtrm)."""
@@ -400,6 +404,7 @@ def _ir_solve(Af, b, solve_lo, opts: Options):
     return x, iters, converged
 
 
+@instrument
 def posv_mixed(A, B, opts=None, uplo=None):
     """SPD solve: low-precision factor + working-precision refinement
     (src/posv_mixed.cc), run as the declared mixed→full escalation ladder
@@ -482,6 +487,7 @@ def posv_mixed(A, B, opts=None, uplo=None):
     return X, info, state["iters"]
 
 
+@instrument
 def posv_mixed_gmres(A, B, opts=None, uplo=None):
     """SPD GMRES-IR: FGMRES in working precision, right-preconditioned by the
     low-precision Cholesky solve (src/posv_mixed_gmres.cc; single RHS like the

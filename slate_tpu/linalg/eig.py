@@ -41,6 +41,7 @@ from ..ops import norms as norm_ops
 from ..robust import inject
 from ..utils.trace import Timers, record_phases, trace_block
 from .chol import _full_spd, potrf
+from ..obs import instrument
 
 
 def _full_herm(A, uplo):
@@ -62,6 +63,7 @@ def _safe_scale(a):
     return a * sigma.astype(a.dtype), 1.0 / sigma
 
 
+@instrument
 def heev(A, opts=None, uplo=None, want_vectors: bool = True,
          method: str = "fused", chase_pipeline: bool = False,
          chase_distributed: bool = False):
@@ -155,6 +157,7 @@ def heev(A, opts=None, uplo=None, want_vectors: bool = True,
     return (lam, z) if want_vectors else (lam, None)
 
 
+@instrument
 def heev_range(A, opts=None, uplo=None, *, il: int = 0,
                iu: Optional[int] = None, want_vectors: bool = True,
                chase_pipeline: bool = False):
@@ -298,6 +301,7 @@ def _hegv_pipeline(itype: int, A, B, opts, uplo, want_vectors, solve,
     return lam, (z if want_vectors else None)
 
 
+@instrument
 def hegv(itype: int, A, B, opts=None, uplo=None, want_vectors: bool = True):
     """Generalized Hermitian eigensolve A x = lambda B x (src/hegv.cc:
     potrf(B) -> hegst -> heev -> back-transform)."""

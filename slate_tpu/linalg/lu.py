@@ -46,6 +46,7 @@ from ..robust import (RetryPolicy, Rung, SolveReport, first_bad_index, inject,
                       run_ladder)
 from ..utils.trace import trace_block, trace_event
 from .chol import _ir_solve
+from ..obs import instrument
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +231,7 @@ def _getrf_tiled_fn(m: int, n: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def getrf(A, opts=None):
     """Partially-pivoted LU: returns (LU, perm, info) with A[perm] = L U
     (src/getrf.cc:22-260; dispatch over MethodLU like gesv's select_algo).
@@ -441,6 +443,7 @@ def _getrf_tntpiv_fn(m: int, n: int, nb: int, ib: int, dtype_str: str,
     return jax.jit(fn)
 
 
+@instrument
 def getrf_tntpiv(A, opts=None):
     """Tournament-pivoted (CALU) LU (src/getrf_tntpiv.cc:161-230).
     Returns (LU, perm, info)."""
@@ -501,6 +504,7 @@ def getrs_nopiv(LU, B, opts=None, trans=False):
     return getrs(LU, None, B, opts, trans=trans)
 
 
+@instrument
 def gesv(A, B, opts=None):
     """Solve A X = B (src/gesv.cc = getrf + getrs).
 
@@ -602,6 +606,7 @@ def getri_oop(LU, perm, B, opts=None):
 # ---------------------------------------------------------------------------
 
 
+@instrument
 def gesv_mixed(A, B, opts=None):
     """Low-precision LU factor + working-precision iterative refinement
     (src/gesv_mixed.cc:23-40,106+), run as the declared mixed→full escalation
@@ -752,6 +757,7 @@ def _gmres_ir(matvec, precond, b, opts, routine: str):
     return (x if squeeze else x[:, None]), restarts, converged
 
 
+@instrument
 def gesv_mixed_gmres(A, B, opts=None):
     """GMRES-IR: FGMRES in working precision, right-preconditioned by the
     low-precision LU solve (src/gesv_mixed_gmres.cc). Single-RHS path like the
@@ -848,6 +854,7 @@ def gerbt(Wu, Wv, A):
     return write_back(A, a2)
 
 
+@instrument
 def gesv_rbt(A, B, opts=None, key=None):
     """Solve via random butterfly transform + nopiv LU + refinement
     (src/gesv_rbt.cc:94-172), run as the declared RBT→partial-pivot

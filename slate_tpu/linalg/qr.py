@@ -44,6 +44,7 @@ from ..robust import inject
 from ..utils.trace import trace_block
 from ..ops.blas3 import gram
 from .chol import _chol_blocked, _chol_info
+from ..obs import instrument
 
 
 @dataclasses.dataclass
@@ -101,6 +102,7 @@ def _block_T(V, tau):
     return jnp.where(tau[..., None, :] == 0, 0, T)
 
 
+@instrument
 def geqrf(A, opts=None):
     """QR factorization A = Q R (src/geqrf.cc). Returns TriangularFactors; writes the
     packed factor back into a Matrix wrapper (R in the upper triangle, V below)."""
@@ -120,6 +122,7 @@ def geqrf(A, opts=None):
     return fac
 
 
+@instrument
 def gelqf(A, opts=None):
     """LQ factorization A = L Q (src/gelqf.cc) via QR of A^H: A^H = Q1 R1 =>
     A = R1^H Q1^H. Returns TriangularFactors of A^H."""
@@ -216,6 +219,7 @@ def tsqr(a, row_blocks: int = 0, nb: int = 1024):
     return Q, R
 
 
+@instrument
 def cholqr(A, opts=None):
     """Cholesky QR (src/cholqr.cc): R = chol(A^H A)^H upper, Q = A R^{-1}, with a
     CholeskyQR2 second pass for orthogonality and a shifted retry if the Gram matrix
@@ -337,6 +341,7 @@ def _gels_csne(a, b):
     return lax.cond(bad, qr_path, lambda _: x, None)
 
 
+@instrument
 def gels(A, BX, opts=None):
     """Least squares min ||A X - B|| / minimum-norm solve (src/gels.cc dispatch:
     MethodGels QR vs CholQR; src/gels_qr.cc, src/gels_cholqr.cc).

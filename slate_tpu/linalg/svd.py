@@ -27,8 +27,10 @@ from ..robust import inject
 from ..utils.trace import Timers, record_phases, trace_block
 from .eig import _safe_scale
 from .qr import geqrf, unmqr
+from ..obs import instrument
 
 
+@instrument
 def svd(A, opts=None, want_u: bool = True, want_vt: bool = True,
         method: str = "fused", chase_pipeline: bool = False,
         chase_distributed: bool = False):

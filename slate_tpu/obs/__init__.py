@@ -1,0 +1,71 @@
+"""Unified observability layer (round-8 tentpole).
+
+Three pillars, one import:
+
+* **Metrics registry** (:mod:`.registry`) — process-wide counters, gauges,
+  and histograms with labels (routine, dtype, shape_bucket, mesh, nb,
+  method, lu_panel ...), exported as one ``metrics.json`` document
+  (schema ``slate_tpu.metrics/v1``) shared by bench, tester, and chaos
+  runs.
+* **Span API** (:mod:`.spans`) — ``obs.scope(routine, **labels)`` wraps a
+  driver invocation: chrome-trace region (via ``utils.trace.trace_block``)
+  plus registry counters/histograms.  ``obs.instrument`` is the decorator
+  every public distributed driver wears.
+* **Compiled-cost audit** (:mod:`.costaudit` / :mod:`.scaling`) — harvest
+  ``cost_analysis`` + compiled-HLO collective volume for every
+  ``parallel/`` routine on a P-device mesh; ``tools/gen_scaling.py``
+  renders SCALING.md and pins the P=2 envelopes for CI.
+
+Reference analogue: none — SLATE's observability is printed tester columns
+and trace SVGs; the registry/audit unification is this reproduction's
+addition (FlatAttention's collective-volume accounting and BLASX's
+throughput telemetry are the exemplars, PAPERS.md).
+"""
+
+from .registry import (REGISTRY, SCHEMA, Counter, Gauge, Histogram,
+                       MetricsRegistry, validate_metrics)
+from .spans import (INSTRUMENT_ATTR, current_span, instrument, on_phases,
+                    scope, span_depth)
+from .costaudit import COLLECTIVE_OPS, collective_volume, harvest, harvest_many
+from .scaling import (AUDIT_N, AUDIT_NB, RoutineSpec, audit_all,
+                      audit_routine, make_grid, spec_names, specs)
+
+
+def counter(name: str, help: str = "") -> Counter:
+    """Get-or-create a counter on the process registry."""
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    """Get-or-create a gauge (last-write-wins sample) on the process registry."""
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", **kw) -> Histogram:
+    """Get-or-create a histogram (bucketed distribution) on the process registry."""
+    return REGISTRY.histogram(name, help, **kw)
+
+
+def metrics_doc(source: str = "unknown") -> dict:
+    """The current ``metrics.json`` document (validated shape)."""
+    return REGISTRY.collect(source=source)
+
+
+def export_metrics(path: str, source: str = "unknown") -> str:
+    """Write ``metrics.json`` for this run; returns the path."""
+    return REGISTRY.export(path, source=source)
+
+
+def reset() -> None:
+    """Drop all metrics (test isolation / fresh-run boundary)."""
+    REGISTRY.reset()
+
+
+__all__ = [
+    "REGISTRY", "SCHEMA", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "validate_metrics", "INSTRUMENT_ATTR", "current_span", "instrument",
+    "on_phases", "scope", "span_depth", "COLLECTIVE_OPS", "collective_volume",
+    "harvest", "harvest_many", "AUDIT_N", "AUDIT_NB", "RoutineSpec",
+    "audit_all", "audit_routine", "make_grid", "spec_names", "specs",
+    "counter", "gauge", "histogram", "metrics_doc", "export_metrics", "reset",
+]

@@ -1,0 +1,175 @@
+"""Compiled-cost audit: collective volume + per-device flops/bytes from XLA.
+
+This generalizes the PR-2 ``kernel_plan``-vs-``traced_plan`` pattern (one
+Pallas launch audited against its traced index maps) to *whole distributed
+programs*: for a compiled SPMD executable, harvest
+
+* ``cost_analysis()`` — per-device flops and bytes accessed (GSPMD partitions
+  before backend compilation, so the compiled module IS the per-device
+  program), and
+* the compiled HLO text — every collective op (all-reduce / all-gather /
+  reduce-scatter / all-to-all / collective-permute / collective-broadcast,
+  sync or async ``-start`` form) with its output shape, summed into bytes.
+
+The byte accounting is **static-site** volume: each collective instruction
+counts once with its compiled shape.  Collectives inside a ``while`` loop
+execute once per iteration at run time, so absolute numbers are a lower
+bound there — but the number is *deterministic for a given program*, which
+is what a CI envelope needs: a schedule change that doubles the gathered
+panel or swaps a psum for an all-gather moves the static volume immediately.
+SCALING.md records the caveat next to the numbers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+#: collective opcodes audited (HLO spellings); ``-start`` async variants are
+#: folded into their base op, ``-done`` halves are skipped (no double count)
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                  "collective-permute", "collective-broadcast")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# one typed shape: f32[128,256]{1,0:T(8,128)} / u32[] / pred[4]
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+# an HLO instruction: %name = <shape-or-tuple> opcode(
+# the tuple alternative tolerates one paren-nesting level so tiled-layout
+# annotations inside tuple shapes — `(f32[128,128]{1,0:T(8,128)}, ...)` on
+# TPU-compiled modules — don't truncate the match and drop the opcode
+_INSTR_RE = re.compile(
+    r"=\s*((?:\((?:[^()]|\([^()]*\))*\))|(?:[a-z0-9]+\[[^\]]*\]\S*))"
+    r"\s+([a-z0-9-]+)\(")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Bytes of one HLO shape string; tuple shapes sum their elements."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:           # token[] / opaque[] / unknown — no bytes
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def collective_volume(hlo_text: str) -> Dict[str, Any]:
+    """Parse compiled HLO text into the collective-op bill of materials.
+
+    Returns ``{"total_bytes": int, "total_count": int,
+    "ops": {op: {"count": n, "bytes": b}}}`` — bytes are the collective's
+    output shape (the data each device materializes from the fabric at that
+    site), per device, static sites only (module docstring caveat).
+    """
+    ops: Dict[str, Dict[str, int]] = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        shape_text, opcode = m.group(1), m.group(2)
+        if opcode.endswith("-done"):
+            continue
+        is_start = opcode.endswith("-start")
+        base = opcode[:-6] if is_start else opcode
+        if base not in COLLECTIVE_OPS:
+            continue
+        if is_start:
+            # an async start's tuple shape is (operand-alias, result, ...,
+            # context): bill only the result — element 1 when the tuple has
+            # one (trailing u32[] scheduling contexts would undercount a
+            # shapes[-1] pick) — so the async form measures the same bytes
+            # as its sync spelling (no double count)
+            shapes = _SHAPE_RE.findall(shape_text)
+            size = 0
+            if shapes:
+                dtype, dims = shapes[1] if len(shapes) >= 2 else shapes[0]
+                per = _DTYPE_BYTES.get(dtype, 0)
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                size = n * per
+        else:
+            size = _shape_bytes(shape_text)
+        entry = ops.setdefault(base, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += size
+    return {"total_bytes": sum(o["bytes"] for o in ops.values()),
+            "total_count": sum(o["count"] for o in ops.values()),
+            "ops": ops}
+
+
+def _cost_analysis(compiled) -> Dict[str, float]:
+    """``Compiled.cost_analysis()`` across jax versions (same shim as
+    ``slate_tpu.testing.cost_analysis_dict`` — duplicated here so obs does
+    not import the tester)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def harvest(compiled) -> Dict[str, Any]:
+    """Audit one compiled executable: per-device flops/bytes + collectives.
+
+    ``compiled`` is a ``jax.stages.Compiled`` (``jit(f).lower(...).compile()``).
+    Returns::
+
+        {"flops": float, "bytes_accessed": float,
+         "collective_bytes": int, "collective_count": int,
+         "collectives": {op: {count, bytes}},
+         "comm_compute_ratio": float | None}   # collective bytes per flop
+    """
+    ca = _cost_analysis(compiled)
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = ""
+    vol = collective_volume(hlo)
+    flops = float(ca.get("flops", 0.0))
+    out = {
+        "flops": flops,
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "collective_bytes": int(vol["total_bytes"]),
+        "collective_count": int(vol["total_count"]),
+        "collectives": vol["ops"],
+        "comm_compute_ratio": (vol["total_bytes"] / flops) if flops > 0
+        else None,
+    }
+    return out
+
+
+def harvest_many(compiled_list) -> Dict[str, Any]:
+    """Sum :func:`harvest` across several compiled programs.
+
+    Host-composed drivers lower to more than one executable."""
+    agg: Dict[str, Any] = {"flops": 0.0, "bytes_accessed": 0.0,
+                           "collective_bytes": 0, "collective_count": 0,
+                           "collectives": {}, "programs": 0}
+    for compiled in compiled_list:
+        h = harvest(compiled)
+        agg["flops"] += h["flops"]
+        agg["bytes_accessed"] += h["bytes_accessed"]
+        agg["collective_bytes"] += h["collective_bytes"]
+        agg["collective_count"] += h["collective_count"]
+        agg["programs"] += 1
+        for op, e in h["collectives"].items():
+            dst = agg["collectives"].setdefault(op, {"count": 0, "bytes": 0})
+            dst["count"] += e["count"]
+            dst["bytes"] += e["bytes"]
+    agg["comm_compute_ratio"] = (agg["collective_bytes"] / agg["flops"]
+                                 if agg["flops"] > 0 else None)
+    return agg

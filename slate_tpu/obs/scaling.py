@@ -1,0 +1,406 @@
+"""Per-routine scaling audit: compile every distributed routine on a P-device
+mesh and harvest its collective volume + per-device flops/bytes.
+
+This is the in-env evidence layer behind SCALING.md (ROADMAP item 4): each
+:class:`RoutineSpec` knows how to AOT-compile one ``parallel/`` routine at a
+fixed audit shape on a CPU mesh (``jit(...).lower(...).compile()`` — nothing
+executes, same discipline as tools/twostage_scale.py), and
+:func:`audit_routine` runs the compiled module through
+:mod:`slate_tpu.obs.costaudit`.  ``tools/gen_scaling.py`` renders the table
+at P ∈ {2, 4, 8} and pins the P=2 collective volumes for CI
+(tests/test_perf_pins.py).
+
+Audit shapes are deliberately small (n=128-class): the *shape* of the
+compiled program — which collectives, how many, what they carry relative to
+the problem — is what regresses when a schedule changes, and it shows at any
+size.  Absolute volumes at BASELINE scale follow from the same program by
+the documented per-site shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .costaudit import harvest
+
+#: the default audit problem edge (divisible by every grid in P ∈ {2,4,8}
+#: and by the nb=32 blocking the specs use)
+AUDIT_N = 128
+AUDIT_NB = 32
+#: band audits: half-bandwidth small enough for the chase's seg >= 2kd+2
+#: constraint at P=8 (seg = 128/8 = 16 >= 2*4+2)
+AUDIT_KD = 4
+
+_DTYPE = np.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class RoutineSpec:
+    """One audited distributed routine.
+
+    name:     row label (the public driver's name).
+    module:   owning ``slate_tpu.parallel`` module (table grouping).
+    build:    ``build(grid) -> jax.stages.Compiled`` for the audit shape.
+    model_flops: whole-problem flop model at the audit shape (the table's
+              "model" column; per-device flops come from cost_analysis).
+    requires: optional grid predicate (e.g. Cannon's square-grid-only ring).
+    """
+
+    name: str
+    module: str
+    build: Callable[[Any], Any]
+    model_flops: float = 0.0
+    requires: Optional[Callable[[Any], bool]] = None
+
+
+def _rng(seed: int = 0):
+    return np.random.default_rng(seed)
+
+
+def _randn(m: int, n: int):
+    import jax.numpy as jnp
+
+    return jnp.asarray(_rng(m * 131 + n).standard_normal((m, n)),
+                       dtype=_DTYPE)
+
+
+def _spd(n: int):
+    import jax.numpy as jnp
+
+    g = _rng(n).standard_normal((n, n))
+    return jnp.asarray(g @ g.T + n * np.eye(n), dtype=_DTYPE)
+
+
+def _aot(fn, *args):
+    """AOT-compile ``fn(*args)`` (compile-only: nothing executes)."""
+    import jax
+
+    return jax.jit(fn).lower(*args).compile()
+
+
+def _square_grid(grid) -> bool:
+    return grid.p == grid.q
+
+
+def _build_specs() -> List[RoutineSpec]:
+    """The audit table.  Imports live inside the builders so ``import
+    slate_tpu.obs`` stays jax-light; every builder closes over nothing but
+    the grid handed to it."""
+    from ..parallel import (band_dist, blas3_dist, chase_dist, eig_dist,
+                            indefinite_dist, inverse, lu_dist, pipeline,
+                            qr_dist, rbt, secular, solvers, summa)
+
+    n, nb, kd = AUDIT_N, AUDIT_NB, AUDIT_KD
+    mt = 4 * n                     # tall-panel audit height
+    nrhs = 16
+
+    specs = [
+        # -- summa ----------------------------------------------------------
+        RoutineSpec(
+            "gemm_allgather", "summa",
+            lambda g: _aot(lambda a, b: summa.gemm_allgather(a, b, g),
+                           _randn(n, n), _randn(n, n)),
+            model_flops=2 * n**3),
+        RoutineSpec(
+            "gemm_ring", "summa",
+            lambda g: _aot(lambda a, b: summa.gemm_ring(a, b, g),
+                           _randn(n, n), _randn(n, n)),
+            model_flops=2 * n**3, requires=_square_grid),
+        # -- blas3_dist ------------------------------------------------------
+        RoutineSpec(
+            "herk_distributed", "blas3_dist",
+            lambda g: _aot(lambda a, c: blas3_dist.herk_distributed(
+                1.0, a, 0.0, c, g), _randn(n, n), _spd(n)),
+            model_flops=n**3),
+        RoutineSpec(
+            "trmm_distributed", "blas3_dist",
+            lambda g: _aot(lambda a, b: blas3_dist.trmm_distributed(
+                "left", 1.0, a, b, g), _spd(n), _randn(n, n)),
+            model_flops=n**3),
+        # -- solvers ---------------------------------------------------------
+        RoutineSpec(
+            "potrf_distributed", "solvers",
+            lambda g: _aot(lambda a: solvers.potrf_distributed(a, g, nb=nb),
+                           _spd(n)),
+            model_flops=n**3 / 3),
+        RoutineSpec(
+            "trsm_distributed", "solvers",
+            lambda g: _aot(lambda l, b: solvers.trsm_distributed(l, b, g),
+                           _spd(n), _randn(n, nrhs)),
+            model_flops=n * n * nrhs),
+        RoutineSpec(
+            "trsmA_distributed", "solvers",
+            lambda g: _aot(lambda a, b: solvers.trsmA_distributed(a, b, g),
+                           _spd(n), _randn(n, nrhs)),
+            model_flops=n * n * nrhs),
+        RoutineSpec(
+            "posv_distributed", "solvers",
+            lambda g: _aot(lambda a, b: solvers.posv_distributed(
+                a, b, g, nb=nb), _spd(n), _randn(n, nrhs)),
+            model_flops=n**3 / 3 + 2 * n * n * nrhs),
+        RoutineSpec(
+            "cholqr_distributed", "solvers",
+            lambda g: _aot(lambda a: solvers.cholqr_distributed(a, g),
+                           _randn(mt, nb)),
+            model_flops=2 * mt * nb * nb),
+        RoutineSpec(
+            "gels_cholqr_distributed", "solvers",
+            lambda g: _aot(lambda a, b: solvers.gels_cholqr_distributed(
+                a, b, g), _randn(mt, nb), _randn(mt, nrhs)),
+            model_flops=2 * mt * nb * nb + 2 * mt * nb * nrhs),
+        # -- lu_dist ---------------------------------------------------------
+        RoutineSpec(
+            "getrf_distributed", "lu_dist",
+            lambda g: _aot(lambda a: lu_dist.getrf_distributed(a, g, nb=nb),
+                           _randn(n, n)),
+            model_flops=2 * n**3 / 3),
+        RoutineSpec(
+            "getrf_tall_distributed", "lu_dist",
+            lambda g: _aot(lambda a: lu_dist.getrf_tall_distributed(
+                a, g, nb=nb), _randn(mt, nb)),
+            model_flops=mt * nb * nb),
+        RoutineSpec(
+            "gesv_distributed", "lu_dist",
+            lambda g: _aot(lambda a, b: lu_dist.gesv_distributed(
+                a, b, g, nb=nb), _randn(n, n), _randn(n, nrhs)),
+            model_flops=2 * n**3 / 3 + 2 * n * n * nrhs),
+        # -- rbt -------------------------------------------------------------
+        RoutineSpec(
+            "getrf_nopiv_distributed", "rbt",
+            lambda g: _aot(lambda a: rbt.getrf_nopiv_distributed(
+                a, g, nb=nb), _spd(n)),
+            model_flops=2 * n**3 / 3),
+        # -- qr_dist ---------------------------------------------------------
+        RoutineSpec(
+            "tsqr_distributed", "qr_dist",
+            lambda g: _aot(lambda a: qr_dist.tsqr_distributed(a, g),
+                           _randn(mt, nb)),
+            model_flops=2 * mt * nb * nb),
+        RoutineSpec(
+            "geqrf_distributed", "qr_dist",
+            lambda g: _aot(lambda a: qr_dist.geqrf_distributed(a, g, nb=nb),
+                           _randn(n, n)),
+            model_flops=4 * n**3 / 3),
+        # -- eig_dist --------------------------------------------------------
+        RoutineSpec(
+            "he2hb_distributed", "eig_dist",
+            lambda g: _aot(lambda a: eig_dist.he2hb_distributed(a, g, nb=nb),
+                           _spd(n)),
+            model_flops=4 * n**3 / 3),
+        RoutineSpec(
+            "ge2tb_distributed", "eig_dist",
+            lambda g: _aot(lambda a: eig_dist.ge2tb_distributed(a, g, nb=nb),
+                           _randn(n, n)),
+            model_flops=8 * n**3 / 3),
+        RoutineSpec(
+            "norm_distributed", "eig_dist",
+            lambda g: _aot(lambda a: eig_dist.norm_distributed("fro", a, g),
+                           _randn(n, n)),
+            model_flops=2 * n * n),
+        RoutineSpec(
+            "steqr_distributed", "eig_dist",
+            lambda g: _aot(lambda d, e: eig_dist.steqr_distributed(d, e, g),
+                           _randn(n, 1)[:, 0],
+                           _randn(n - 1, 1)[:, 0]),
+            model_flops=6 * n**3),
+        # -- secular ---------------------------------------------------------
+        RoutineSpec(
+            "secular_roots_sharded", "secular",
+            lambda g: _aot(
+                lambda d, z2: secular.secular_roots_sharded(
+                    d, z2, np.float32(1.0), g),
+                np.sort(np.abs(_rng(3).standard_normal(n))).astype(_DTYPE)
+                + np.arange(n, dtype=_DTYPE),
+                (np.abs(_rng(5).standard_normal(n)) + 0.1).astype(_DTYPE)),
+            model_flops=90 * n * n),
+        # -- chase_dist ------------------------------------------------------
+        RoutineSpec(
+            "hb2st_chase_distributed", "chase_dist",
+            lambda g: _aot(lambda a: chase_dist.hb2st_chase_distributed(
+                a, kd, g), _band_sym(n, kd)),
+            model_flops=6 * n * n * kd),
+        RoutineSpec(
+            "tb2bd_chase_distributed", "chase_dist",
+            lambda g: _aot(lambda b: chase_dist.tb2bd_chase_distributed(
+                b, kd, g), _band_upper(n, kd)),
+            model_flops=6 * n * n * kd),
+        # -- band_dist -------------------------------------------------------
+        RoutineSpec(
+            "pbtrf_distributed", "band_dist",
+            lambda g: _aot(lambda ab: band_dist.pbtrf_distributed(
+                ab, g, kd=kd, nb=nb),
+                band_dist.dense_to_band_lower(_spd(n), kd)),
+            model_flops=n * kd * kd),
+        RoutineSpec(
+            "gbtrf_distributed", "band_dist",
+            lambda g: _aot(lambda gb: band_dist.gbtrf_distributed(
+                gb, g, kl=kd, ku=kd, nb=nb),
+                band_dist.dense_to_band_general(_spd(n), kd, kd, extra=kd)),
+            model_flops=2 * n * kd * kd),
+        # -- indefinite_dist -------------------------------------------------
+        RoutineSpec(
+            "hetrf_distributed", "indefinite_dist",
+            lambda g: _aot(lambda a: indefinite_dist.hetrf_distributed(
+                a, g, nb=nb), _spd(n)),
+            model_flops=n**3 / 3),
+        # -- inverse ---------------------------------------------------------
+        RoutineSpec(
+            "trtri_distributed", "inverse",
+            lambda g: _aot(lambda t: inverse.trtri_distributed(t, g),
+                           _spd(n)),
+            model_flops=n**3 / 3),
+        RoutineSpec(
+            "potri_distributed", "inverse",
+            lambda g: _aot(lambda l: inverse.potri_distributed(l, g),
+                           _spd(n)),
+            model_flops=2 * n**3 / 3),
+        # -- pipeline --------------------------------------------------------
+        RoutineSpec(
+            "potrf_pipelined", "pipeline",
+            lambda g: _aot(lambda a: pipeline.potrf_pipelined(a, g, nb=nb),
+                           _spd(n)),
+            model_flops=n**3 / 3),
+    ]
+    return specs
+
+
+def _band_sym(n: int, kd: int):
+    """Dense-storage Hermitian band matrix (the chase's input shape)."""
+    import jax.numpy as jnp
+
+    a = np.asarray(_spd(n))
+    mask = np.abs(np.arange(n)[:, None] - np.arange(n)[None, :]) <= kd
+    return jnp.asarray(a * mask, dtype=_DTYPE)
+
+
+def _band_upper(n: int, kd: int):
+    """Dense-storage upper-band matrix (tb2bd's input shape)."""
+    import jax.numpy as jnp
+
+    a = np.asarray(_randn(n, n))
+    off = np.arange(n)[None, :] - np.arange(n)[:, None]
+    mask = (off >= 0) & (off <= kd)
+    return jnp.asarray(a * mask + np.eye(n) * n, dtype=_DTYPE)
+
+
+_SPECS_CACHE: Optional[List[RoutineSpec]] = None
+
+
+def specs() -> List[RoutineSpec]:
+    """The audit registry: one RoutineSpec per audited distributed routine."""
+    global _SPECS_CACHE
+    if _SPECS_CACHE is None:
+        _SPECS_CACHE = _build_specs()
+    return _SPECS_CACHE
+
+
+def spec_names() -> List[str]:
+    """Names of every routine in the audit registry (SCALING.md row labels)."""
+    return [s.name for s in specs()]
+
+
+def make_grid(nproc: int):
+    """Build a ProcessGrid over the first ``nproc`` visible devices.
+
+    The audit's "mpirun -np P" analogue on the virtual CPU mesh."""
+    import jax
+
+    from ..parallel import ProcessGrid
+
+    devs = jax.devices()
+    if len(devs) < nproc:
+        raise RuntimeError(
+            f"audit at P={nproc} needs {nproc} devices, have {len(devs)} "
+            "(set --xla_force_host_platform_device_count)")
+    return ProcessGrid(devices=devs[:nproc])
+
+
+def audit_routine(spec: RoutineSpec, grid) -> Dict[str, Any]:
+    """Compile one routine on ``grid`` and harvest its compiled costs.
+
+    Returns the :func:`costaudit.harvest` dict extended with routine/mesh
+    metadata, or ``{"error": ...}`` when the spec does not apply or fails to
+    compile (the table renders the reason instead of dying)."""
+    meta = {"routine": spec.name, "module": spec.module,
+            "P": grid.size, "grid": f"{grid.p}x{grid.q}",
+            "model_flops": spec.model_flops}
+    if spec.requires is not None and not spec.requires(grid):
+        return dict(meta, skipped="grid constraint "
+                    "(e.g. square-grid-only algorithm)")
+    try:
+        compiled = spec.build(grid)
+    except Exception as e:   # surface, don't die: the table shows the reason
+        return dict(meta, error=f"{type(e).__name__}: {e}")
+    out = harvest(compiled)
+    out.update(meta)
+    return out
+
+
+def check_pins(rows: Sequence[Dict[str, Any]], pins: Dict[str, Any]
+               ) -> List[str]:
+    """Diff audited rows against a SCALING_PINS.json document; returns the
+    list of regressions (empty = gate passes).
+
+    One implementation serves both gates — ``tools/gen_scaling.py --check``
+    (the CI scaling-audit step) and ``tests/test_perf_pins.py::
+    TestCollectivePins`` — so the envelope semantics cannot drift.  A routine
+    that is audited-but-unpinned is itself a failure: a shrunk or partially
+    regenerated pin file must not let the gate pass vacuously."""
+    bad: List[str] = []
+    nproc = int(pins.get("P", 2))
+    slack = float(pins.get("bytes_slack", 1.25))
+    cslack = int(pins.get("count_slack", 2))
+    pinned = pins.get("routines", {})
+    fresh = {r["routine"]: r for r in rows if r.get("P") == nproc}
+    for name, pin in sorted(pinned.items()):
+        row = fresh.get(name)
+        if row is None:
+            bad.append(f"{name}: pinned but missing from the audit registry")
+            continue
+        if row.get("error") or row.get("skipped"):
+            bad.append(f"{name}: audit failed: "
+                       f"{row.get('error') or row.get('skipped')}")
+            continue
+        if row["collective_bytes"] > slack * pin["collective_bytes"]:
+            bad.append(f"{name}: collective bytes {row['collective_bytes']} "
+                       f"> {slack} x pinned {pin['collective_bytes']}")
+        if row["collective_count"] > pin["collective_count"] + cslack:
+            bad.append(f"{name}: collective sites {row['collective_count']} "
+                       f"> pinned {pin['collective_count']} + {cslack}")
+    for name in sorted(set(fresh) - set(pinned)):
+        row = fresh[name]
+        if row.get("skipped"):
+            continue          # grid-constrained at this P — nothing to pin
+        if row.get("error"):
+            # --update-pins drops error rows, so "unpinned" would point at
+            # the wrong remedy: surface the compile failure itself
+            bad.append(f"{name}: audit failed: {row['error']}")
+            continue
+        bad.append(f"{name}: audited but unpinned "
+                   "(run tools/gen_scaling.py --update-pins)")
+    return bad
+
+
+def audit_all(nprocs: Sequence[int] = (2, 4, 8),
+              names: Optional[Sequence[str]] = None,
+              progress: Optional[Callable[[Dict[str, Any]], None]] = None
+              ) -> List[Dict[str, Any]]:
+    """Audit every routine spec at every requested device count.
+
+    This is the full SCALING.md table.  Rows carrying ``error``/``skipped``
+    keys mark non-applicable combinations."""
+    rows = []
+    wanted = set(names) if names else None
+    for nproc in nprocs:
+        grid = make_grid(nproc)
+        for spec in specs():
+            if wanted is not None and spec.name not in wanted:
+                continue
+            row = audit_routine(spec, grid)
+            rows.append(row)
+            if progress is not None:
+                progress(row)
+    return rows

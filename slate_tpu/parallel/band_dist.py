@@ -41,6 +41,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 AX = (ROW_AXIS, COL_AXIS)
 
@@ -190,6 +191,7 @@ def _pbtrf_dist_fn(mesh, npad: int, kd: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def pbtrf_distributed(Ab: jax.Array, grid: ProcessGrid, kd: int,
                       nb: int = 256):
     """Distributed band Cholesky on compact lower storage (src/pbtrf.cc).
@@ -283,6 +285,7 @@ def _tbsm_dist_fn(mesh, npad: int, kd: int, nb: int, nrhs: int,
     return jax.jit(fn)
 
 
+@instrument
 def tbsm_distributed(Lb: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
                      nb: int = 256, trans: bool = False,
                      unit_diagonal: bool = False) -> jax.Array:
@@ -317,6 +320,7 @@ def tbsm_distributed(Lb: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
     return X[:, 0] if vec else X
 
 
+@instrument
 def pbtrs_distributed(Lb: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
                       nb: int = 256) -> jax.Array:
     """Solve L L^H X = B from the distributed band factor (src/pbtrs.cc)."""
@@ -324,6 +328,7 @@ def pbtrs_distributed(Lb: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
     return tbsm_distributed(Lb, Y, grid, kd, nb=nb, trans=True)
 
 
+@instrument
 def pbsv_distributed(Ab: jax.Array, B: jax.Array, grid: ProcessGrid, kd: int,
                      nb: int = 256):
     """Distributed SPD band solve (src/pbsv.cc = pbtrf + pbtrs)."""
@@ -458,6 +463,7 @@ def _gbtrf_dist_fn(mesh, npad: int, kl: int, ku: int, nb: int,
     return jax.jit(fn)
 
 
+@instrument
 def gbtrf_distributed(Gb: jax.Array, grid: ProcessGrid, kl: int, ku: int,
                       nb: int = 256):
     """Distributed band LU (src/gbtrf.cc) on compact storage with kl fill
@@ -568,6 +574,7 @@ def _gbtrs_bwd_dist_fn(mesh, npad: int, kl: int, ku: int, nb: int, nrhs: int,
     return jax.jit(fn)
 
 
+@instrument
 def gbtrs_distributed(fac: BandLUDist, B: jax.Array,
                       grid: ProcessGrid) -> jax.Array:
     """Solve from the distributed band LU (src/gbtrs.cc): pivoted forward
@@ -603,6 +610,7 @@ def gbtrs_distributed(fac: BandLUDist, B: jax.Array,
     return X[:, 0] if vec else X
 
 
+@instrument
 def gbsv_distributed(Gb: jax.Array, B: jax.Array, grid: ProcessGrid, kl: int,
                      ku: int, nb: int = 256):
     """Distributed general band solve (src/gbsv.cc = gbtrf + gbtrs)."""

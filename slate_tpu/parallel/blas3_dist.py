@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import lcm, pad2d
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 _PREC = lax.Precision.HIGHEST
 
@@ -134,6 +135,7 @@ def _run_rank_k(alpha, A, B, beta, C, grid, lower, herm, two):
     return out[:n, :n] if npad != n else out
 
 
+@instrument
 def herk_distributed(alpha, A, beta, C, grid: ProcessGrid,
                      uplo: str = "lower") -> jax.Array:
     """C_uplo = alpha A A^H + beta C_uplo, C sharded (p, q) (src/herk.cc).
@@ -142,6 +144,7 @@ def herk_distributed(alpha, A, beta, C, grid: ProcessGrid,
                        herm=True, two=False)
 
 
+@instrument
 def syrk_distributed(alpha, A, beta, C, grid: ProcessGrid,
                      uplo: str = "lower") -> jax.Array:
     """C_uplo = alpha A A^T + beta C_uplo (src/syrk.cc)."""
@@ -149,6 +152,7 @@ def syrk_distributed(alpha, A, beta, C, grid: ProcessGrid,
                        herm=False, two=False)
 
 
+@instrument
 def her2k_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
                       uplo: str = "lower") -> jax.Array:
     """C_uplo = alpha A B^H + conj(alpha) B A^H + beta C_uplo (src/her2k.cc)."""
@@ -156,6 +160,7 @@ def her2k_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
                        herm=True, two=True)
 
 
+@instrument
 def syr2k_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
                       uplo: str = "lower") -> jax.Array:
     """C_uplo = alpha (A B^T + B A^T) + beta C_uplo (src/syr2k.cc)."""
@@ -185,6 +190,7 @@ def _hemm_fn(mesh, left: bool, lower: bool, herm: bool):
                    out_shardings=spec)
 
 
+@instrument
 def hemm_distributed(side, alpha, A, B, beta, C, grid: ProcessGrid,
                      uplo: str = "lower", herm: bool = True) -> jax.Array:
     """C = alpha A B + beta C (side=left) or alpha B A + beta C (side=right),
@@ -207,6 +213,7 @@ def hemm_distributed(side, alpha, A, B, beta, C, grid: ProcessGrid,
     return out[:m, :n] if out.shape[-2:] != (m, n) else out
 
 
+@instrument
 def symm_distributed(side, alpha, A, B, beta, C, grid: ProcessGrid,
                      uplo: str = "lower") -> jax.Array:
     return hemm_distributed(side, alpha, A, B, beta, C, grid, uplo, herm=False)
@@ -230,6 +237,7 @@ def _trmm_fn(mesh, left: bool, lower: bool, trans: bool, unit_diag: bool):
     return jax.jit(fn, in_shardings=(spec, spec, None), out_shardings=spec)
 
 
+@instrument
 def gbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
                      kl: int, ku: int) -> jax.Array:
     """C = alpha A B + beta C with A a general band matrix (src/gbmm.cc over
@@ -260,6 +268,7 @@ def gbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
     return out[:m, :n] if out.shape[-2:] != (m, n) else out
 
 
+@instrument
 def hbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
                      kd: int, uplo: str = "lower",
                      side: str = "left") -> jax.Array:
@@ -276,6 +285,7 @@ def hbmm_distributed(alpha, A, B, beta, C, grid: ProcessGrid,
     return hemm_distributed(side, alpha, tri, B, beta, C, grid, uplo=uplo)
 
 
+@instrument
 def trmm_distributed(side, alpha, A, B, grid: ProcessGrid,
                      uplo: str = "lower", conj_trans: bool = False,
                      unit_diag: bool = False) -> jax.Array:

@@ -54,6 +54,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.exceptions import slate_assert
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 AX = (ROW_AXIS, COL_AXIS)                  # flattened device axis
 
@@ -412,6 +413,7 @@ def _tb2bd_dist_fn(mesh, n: int, b: int, seg: int, want_vectors: bool,
     return jax.jit(fn)
 
 
+@instrument
 def tb2bd_chase_distributed(Bfull: jax.Array, kd: int, grid: ProcessGrid,
                             want_vectors: bool = False):
     """Segment-parallel bidiagonal chase (the SVD stage 2) over ``grid``.
@@ -443,6 +445,7 @@ def tb2bd_chase_distributed(Bfull: jax.Array, kd: int, grid: ProcessGrid,
             Vs[:n_sweeps], tauvs[:n_sweeps])
 
 
+@instrument
 def hb2st_chase_distributed(Afull: jax.Array, kd: int, grid: ProcessGrid,
                             want_vectors: bool = False):
     """Segment-parallel bulge chase over ``grid``'s flattened device list.

@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 
 @lru_cache(maxsize=32)
@@ -149,6 +150,7 @@ def _unmtr_he2hb_shard_fn(mesh, npad: int, ncols: int, nb: int, nj: int,
     return jax.jit(fn)
 
 
+@instrument
 def he2hb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
     """Distributed stage-1 band reduction A = Q band Q^H over the flattened
     mesh.  Returns ``(band, Vs, Ts)``: band (n, n) bandwidth-nb, Vs sharded
@@ -170,6 +172,7 @@ def he2hb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
     return band[:n, :n], Vs, Ts
 
 
+@instrument
 def unmtr_he2hb_distributed(Vs: jax.Array, Ts: jax.Array, C: jax.Array,
                             grid: ProcessGrid, conj_q: bool = False):
     """Apply the stage-1 Q (NoTrans, left) from the sharded reflector stack to
@@ -236,6 +239,7 @@ def _twostage_stage12(A, grid: ProcessGrid, nb: int,
     return d, e_c, Vcs, tcs, Vs1, Ts1, factor, nb
 
 
+@instrument
 def heev_range_distributed(A: jax.Array, grid: ProcessGrid, il: int, iu: int,
                            nb: int = 64, want_vectors: bool = True,
                            chase_pipeline: bool = False,
@@ -379,6 +383,7 @@ def _apply_stacked_left(Vs: jax.Array, Ts: jax.Array, C: jax.Array,
     return unmtr_he2hb_distributed(Vs, Ts, C, grid, conj_q=conj_q)
 
 
+@instrument
 def ge2tb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
     """Distributed stage-1 general->band reduction A = U band V^H over the
     flattened mesh.  Returns ``(band, (Vu, Tu), (Vv, Tv))``: band (m, n)
@@ -402,6 +407,7 @@ def ge2tb_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64):
     return band[:m, :n], (Vu, Tu), (Vv, Tv)
 
 
+@instrument
 def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                      want_vectors: bool = True, method_eig: str = "dc",
                      chase_pipeline: bool = False,
@@ -469,6 +475,7 @@ def heev_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
     return lam * factor, Z
 
 
+@instrument
 def svd_range_distributed(A: jax.Array, grid: ProcessGrid, il: int, iu: int,
                           nb: int = 64, want_vectors: bool = True,
                           chase_pipeline: bool = False,
@@ -628,6 +635,7 @@ def _steqr_shard_fn(mesh):
     return jax.jit(fn)
 
 
+@instrument
 def steqr_distributed(d, e, grid: ProcessGrid, Z=None):
     """Distributed steqr: eigenvalues replicated, eigenvector matrix returned
     row-sharded on the flattened mesh.  ``Z`` (optional) is the matrix to
@@ -644,6 +652,7 @@ def steqr_distributed(d, e, grid: ProcessGrid, Z=None):
     return lam, Zo[:m]
 
 
+@instrument
 def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
                      grid: ProcessGrid, nb: int = 64,
                      want_vectors: bool = True):
@@ -676,6 +685,7 @@ def hegv_distributed(itype: int, A: jax.Array, B: jax.Array,
     return lam, X
 
 
+@instrument
 def svd_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 64,
                     want_vectors: bool = True, chase_pipeline: bool = False,
                     method_svd: str = "auto",
@@ -826,6 +836,7 @@ def _norm_dist_fn(mesh, kind: str, uplo: str, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def norm_distributed(kind, A: jax.Array, grid: ProcessGrid,
                      uplo: str = "general"):
     """Distributed matrix norm (src/norm.cc over internal::genorm partials +
@@ -850,6 +861,7 @@ def _col_norms_fn(mesh):
     return jax.jit(fn)
 
 
+@instrument
 def col_norms_distributed(A: jax.Array, grid: ProcessGrid) -> jax.Array:
     """Distributed column max-norms (internal::colNorms analogue)."""
     return _col_norms_fn(grid.mesh)(A)

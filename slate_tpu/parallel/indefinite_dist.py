@@ -47,6 +47,7 @@ from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 from .pivot import (exchange_rows as _exchange_rows,
                     extract_rows as _extract_rows,
                     step_permutation, tournament_piv)
+from ..obs import instrument
 
 AX = (ROW_AXIS, COL_AXIS)
 
@@ -274,6 +275,7 @@ def _hetrf_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def hetrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """Distributed Aasen factorization P A P^H = L T L^H (src/hetrf.cc).
 
@@ -307,6 +309,7 @@ def hetrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
                                 nb=nb), info
 
 
+@instrument
 def hetrs_distributed(fac: HermitianFactorsDist, B: jax.Array,
                       grid: ProcessGrid) -> jax.Array:
     """Distributed Aasen solve (src/hetrs.cc): permute, unit-lower sweep,
@@ -326,6 +329,7 @@ def hetrs_distributed(fac: HermitianFactorsDist, B: jax.Array,
     return x[:, 0] if vec else x
 
 
+@instrument
 def hesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                      nb: int = 256):
     """Distributed Hermitian-indefinite solve (src/hesv.cc = hetrf + hetrs)."""

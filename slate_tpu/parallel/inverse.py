@@ -23,8 +23,10 @@ import jax.numpy as jnp
 from .mesh import ProcessGrid
 from .solvers import trsm_distributed
 from .summa import gemm_padded
+from ..obs import instrument
 
 
+@instrument
 def trtri_distributed(T: jax.Array, grid: ProcessGrid, lower: bool = True,
                       unit_diagonal: bool = False) -> jax.Array:
     """Distributed triangular inverse (src/trtri.cc): the blocked in-place
@@ -39,6 +41,7 @@ def trtri_distributed(T: jax.Array, grid: ProcessGrid, lower: bool = True,
     return jnp.tril(X) if lower else jnp.triu(X)
 
 
+@instrument
 def trtrm_distributed(T: jax.Array, grid: ProcessGrid,
                       lower: bool = True) -> jax.Array:
     """Distributed L^H L (or U U^H) producing the stored triangle — the
@@ -52,6 +55,7 @@ def trtrm_distributed(T: jax.Array, grid: ProcessGrid,
     return jnp.triu(out)
 
 
+@instrument
 def potri_distributed(L: jax.Array, grid: ProcessGrid,
                       lower: bool = True) -> jax.Array:
     """Distributed SPD inverse from the Cholesky factor: A^{-1} = L^{-H} L^{-1}
@@ -60,6 +64,7 @@ def potri_distributed(L: jax.Array, grid: ProcessGrid,
     return trtrm_distributed(Linv, grid, lower=lower)
 
 
+@instrument
 def getri_distributed(LU: jax.Array, perm: jax.Array,
                       grid: ProcessGrid) -> jax.Array:
     """Distributed inverse from the tournament-LU factor (src/getri.cc:242 /
@@ -70,6 +75,7 @@ def getri_distributed(LU: jax.Array, perm: jax.Array,
     return getrs_distributed(LU, perm, jnp.eye(n, dtype=LU.dtype), grid)
 
 
+@instrument
 def gecondest_distributed(LU, perm, anorm, grid: ProcessGrid,
                           norm_kind=None):
     """Distributed 1-norm condition estimate from the tournament-LU factor
@@ -109,6 +115,7 @@ def gecondest_distributed(LU, perm, anorm, grid: ProcessGrid,
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
 
 
+@instrument
 def pocondest_distributed(L: jax.Array, anorm, grid: ProcessGrid):
     """Distributed SPD condition estimate from the Cholesky factor
     (src/pocondest.cc over the mesh)."""
@@ -126,6 +133,7 @@ def pocondest_distributed(L: jax.Array, anorm, grid: ProcessGrid):
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
 
 
+@instrument
 def trcondest_distributed(T: jax.Array, grid: ProcessGrid, lower: bool = True,
                           unit_diagonal: bool = False, norm_kind=None):
     """Distributed triangular condition estimate (src/trcondest.cc over the

@@ -53,6 +53,7 @@ from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 from .pivot import (exchange_rows as _exchange_rows,
                     select_pivots, step_permutation)
+from ..obs import instrument
 
 
 def _panel_tail(A_loc, pan, LUkk, k0, grow, gcol, pi, qi, mr, mc, nb):
@@ -312,6 +313,7 @@ def _getrf_tall_fn(mesh, mpad: int, npc: int, nb: int, dtype_str: str,
     return jax.jit(fn)
 
 
+@instrument
 def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
                            lu_panel: str = "tournament"):
     """1-D TSLU for tall matrices (m > n) over the flattened mesh.
@@ -375,6 +377,7 @@ def getrf_tall_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
     return LU[:m, :n], perm, info
 
 
+@instrument
 def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
                       lu_panel: str = "tournament"):
     """Distributed tournament-pivoted LU over the process grid.
@@ -458,6 +461,7 @@ def getrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
     return LU, perm, info
 
 
+@instrument
 def getrs_distributed(LU: jax.Array, perm: jax.Array, B: jax.Array,
                       grid: ProcessGrid):
     """Solve A X = B given the distributed LU: X = U^{-1} L^{-1} B[perm]
@@ -473,6 +477,7 @@ def getrs_distributed(LU: jax.Array, perm: jax.Array, B: jax.Array,
     return trsm_distributed(U, Y, grid, lower=False, conj_trans=False)
 
 
+@instrument
 def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                      nb: int = 256, lu_panel: str = "tournament"):
     """Distributed general solve A X = B (src/gesv.cc = getrf + getrs).
@@ -496,6 +501,7 @@ def gesv_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     return X, state["info"]
 
 
+@instrument
 def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                            nb: int = 256, max_iterations: int = 30):
     """Distributed mixed-precision solve (src/gesv_mixed.cc over the mesh):
@@ -527,6 +533,7 @@ def gesv_mixed_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     return X, perm, info, int(iters), True
 
 
+@instrument
 def gesv_mixed_gmres_distributed(A: jax.Array, B: jax.Array,
                                  grid: ProcessGrid, nb: int = 256, opts=None):
     """Distributed GMRES-IR (src/gesv_mixed_gmres.cc over the mesh): FGMRES in

@@ -42,6 +42,7 @@ import numpy as np
 
 from .mesh import ProcessGrid, shard_map
 from ..linalg.chol import _chol_blocked
+from ..obs import instrument
 
 _AXIS = "d"
 
@@ -126,6 +127,7 @@ def _potrf_pipelined_fn(mesh, n: int, nb: int, d: int, dtype_str: str):
                                  out_specs=spec, check_vma=False))
 
 
+@instrument
 def potrf_pipelined(Af: jax.Array, grid: ProcessGrid, nb: int = 256) -> jax.Array:
     """Distributed lower Cholesky with explicit lookahead pipelining over the
     flattened mesh (1-D block-cyclic columns).  Returns the dense lower factor

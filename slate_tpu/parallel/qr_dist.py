@@ -42,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 
 # ---------------------------------------------------------------------------
@@ -73,6 +74,7 @@ def _tsqr_dist_fn(mesh, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def tsqr_distributed(A: jax.Array, grid: ProcessGrid):
     """Tall-skinny QR by tree reduction over the whole mesh (ttqrt analogue).
 
@@ -95,6 +97,7 @@ def tsqr_distributed(A: jax.Array, grid: ProcessGrid):
     return (Q[:m] if mpad != m else Q), R
 
 
+@instrument
 def unmqr_distributed(Q: jax.Array, C: jax.Array, grid: ProcessGrid,
                       trans: bool = True):
     """Apply the explicit distributed Q (or Q^H) to C: one sharded gemm
@@ -110,6 +113,7 @@ def unmqr_distributed(Q: jax.Array, C: jax.Array, grid: ProcessGrid,
     return apply(Qs, Cs)
 
 
+@instrument
 def gels_qr_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid):
     """Overdetermined least squares via distributed TSQR (src/gels_qr.cc):
     X = R^{-1} (Q^H B).  The QR path survives ill-conditioned panels where
@@ -201,6 +205,7 @@ def _geqrf_dist_fn(mesh, mpad: int, npad: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def geqrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """Distributed blocked CAQR of a general m×n matrix (m ≥ n) over the
     (p, q) mesh (src/geqrf.cc:146-253 analogue; BCGS2 + TSQR panels).
@@ -228,6 +233,7 @@ def geqrf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     return Q[:m, :n], R[:n, :n]
 
 
+@instrument
 def gels_caqr_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                           nb: int = 256):
     """Least squares through the 2-D CAQR (general overdetermined A)."""
@@ -236,6 +242,7 @@ def gels_caqr_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     return lax.linalg.triangular_solve(R, QhB, left_side=True, lower=False)
 
 
+@instrument
 def gelqf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     """Distributed LQ factorization A = L Q over the mesh (src/gelqf.cc).
 
@@ -251,6 +258,7 @@ def gelqf_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256):
     return jnp.conj(R1.T), jnp.conj(Q1.T)
 
 
+@instrument
 def unmlq_distributed(Q: jax.Array, C: jax.Array, grid: ProcessGrid,
                       conj_trans: bool = False) -> jax.Array:
     """Apply the LQ factor's Q (rows orthonormal) to C from the left over the
@@ -263,6 +271,7 @@ def unmlq_distributed(Q: jax.Array, C: jax.Array, grid: ProcessGrid,
     return gemm_padded(Qop, C, grid)
 
 
+@instrument
 def gels_lq_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                         nb: int = 256) -> jax.Array:
     """Minimum-norm solution of the underdetermined system A X = B over the

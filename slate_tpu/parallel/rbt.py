@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .distribute import ceil_mult, lcm as _lcm
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 
 @lru_cache(maxsize=32)
@@ -94,6 +95,7 @@ def _getrf_nopiv_dist_fn(mesh, npad: int, nb: int, dtype_str: str):
     return jax.jit(fn)
 
 
+@instrument
 def getrf_nopiv_distributed(A: jax.Array, grid: ProcessGrid, nb: int = 256,
                             trim: bool = True):
     """Distributed LU without pivoting (src/getrf_nopiv.cc over the grid).
@@ -130,6 +132,7 @@ def _transform_jit():
     return jax.jit(transform)
 
 
+@instrument
 def gesv_rbt_distributed(A, B, grid: ProcessGrid, depth: int = 2,
                          nb: int = 256, key=None, max_iterations: int = 30,
                          use_fallback: bool = True, tol=None):

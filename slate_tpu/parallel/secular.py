@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 _FLAT = (ROW_AXIS, COL_AXIS)
 
@@ -52,6 +53,7 @@ def _prep_jit():
     return jax.jit(_secular_prep)
 
 
+@instrument
 def secular_roots_sharded(d, z2, rho, grid: ProcessGrid):
     """All m secular roots with the bisection sharded over the mesh.
 

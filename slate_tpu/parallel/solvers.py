@@ -33,6 +33,7 @@ from ..ops import blas3
 from ..robust import RetryPolicy, Rung, guard_shards, inject, run_ladder
 from ..utils.trace import trace_event
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
+from ..obs import instrument
 
 
 # ---------------------------------------------------------------------------
@@ -135,6 +136,7 @@ def _pad_spd(Af: jax.Array, mult: int):
     return Af2.at[idx, idx].set(1), n
 
 
+@instrument
 def potrf_distributed(Af: jax.Array, grid: ProcessGrid, nb: int = 256,
                       method: str = "auto",
                       lookahead: int = 1) -> jax.Array:
@@ -184,6 +186,7 @@ def _trsm_dist_fn(mesh, lower: bool, trans: bool, dtype_str: str):
     return jax.jit(fn, in_shardings=(spec, spec), out_shardings=spec)
 
 
+@instrument
 def trsm_distributed(L: jax.Array, B: jax.Array, grid: ProcessGrid,
                      lower: bool = True, conj_trans: bool = False) -> jax.Array:
     """Distributed left triangular solve (work::trsm analogue); XLA's blocked
@@ -205,6 +208,7 @@ def trsm_distributed(L: jax.Array, B: jax.Array, grid: ProcessGrid,
     return X[:n, :nrhs] if (npad != n or cpad != nrhs) else X
 
 
+@instrument
 def posv_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
                      nb: int = 256) -> jax.Array:
     """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc), all sharded.
@@ -295,6 +299,7 @@ def _trsmA_dist_fn(mesh, npad: int, nb: int, nrhs: int, lower: bool,
     return jax.jit(fn)
 
 
+@instrument
 def trsmA_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                       lower: bool = True, conj_trans: bool = False,
                       unit_diag: bool = False) -> jax.Array:
@@ -364,6 +369,7 @@ def _ir_refine_distributed(Af, B, solve_lo, grid, max_iterations, tol=None):
     return X, it, done & jnp.all(jnp.isfinite(X))
 
 
+@instrument
 def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
                            nb: int = 256, max_iterations: int = 30):
     """Distributed mixed-precision SPD solve (src/posv_mixed.cc over the mesh):
@@ -403,6 +409,7 @@ def posv_mixed_distributed(Af: jax.Array, B: jax.Array, grid: ProcessGrid,
     return X, state["iters"], via_ir
 
 
+@instrument
 def posv_mixed_gmres_distributed(Af: jax.Array, B: jax.Array,
                                  grid: ProcessGrid, nb: int = 256, opts=None):
     """Distributed SPD GMRES-IR (src/posv_mixed_gmres.cc over the mesh):
@@ -494,6 +501,7 @@ def _cholqr_fn(mesh, precision):
     return jax.jit(fn)
 
 
+@instrument
 def cholqr_distributed(A: jax.Array, grid: ProcessGrid,
                        precision=lax.Precision.HIGHEST):
     """Tall-skinny QR via Cholesky of the Gram matrix (src/cholqr.cc).
@@ -514,6 +522,7 @@ def cholqr_distributed(A: jax.Array, grid: ProcessGrid,
     return (Q[:m] if mpad != m else Q), R
 
 
+@instrument
 def gels_cholqr_distributed(A: jax.Array, B: jax.Array, grid: ProcessGrid):
     """Overdetermined least squares min ||A X - B|| via CholQR
     (src/gels_cholqr.cc): X = R^{-1} (Q^H B)."""

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.exceptions import slate_assert
 from .mesh import COL_AXIS, ProcessGrid, ROW_AXIS, shard_map
 from .collectives import ring_shift
+from ..obs import instrument
 
 
 @lru_cache(maxsize=32)
@@ -49,6 +50,7 @@ def _allgather_fn(mesh, precision):
     return jax.jit(fn)
 
 
+@instrument
 def gemm_allgather(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                    precision=lax.Precision.HIGHEST) -> jax.Array:
     """C = A @ B with A, B, C block-sharded (p, q). One all-gather per operand."""
@@ -110,6 +112,7 @@ def _skew(x, axis_name, size, shift):
     return x
 
 
+@instrument
 def gemm_ring(A: jax.Array, B: jax.Array, grid: ProcessGrid,
               precision=lax.Precision.HIGHEST) -> jax.Array:
     """Cannon's algorithm on a square p×p grid: K stays resident, panels rotate on
@@ -124,6 +127,7 @@ def gemm_ring(A: jax.Array, B: jax.Array, grid: ProcessGrid,
     return _ring_fn(grid.mesh, grid.p, grid.q, precision)(A, B)
 
 
+@instrument
 def summa_gemm(alpha, A, B, beta, C, opts=None, grid: ProcessGrid | None = None):
     """Full gemm entry point for the L5 API (blas.gemm with MethodGemm.SUMMA):
     C = alpha op(A) op(B) + beta C over the default grid of all visible devices.
@@ -146,6 +150,7 @@ def summa_gemm(alpha, A, B, beta, C, opts=None, grid: ProcessGrid | None = None)
     return alpha * prod + beta * c
 
 
+@instrument
 def gemm_distributed(A, B, grid: ProcessGrid, method: str = "auto",
                      precision=lax.Precision.HIGHEST) -> jax.Array:
     """Dispatch like src/gemm.cc select_algo: ring (pipelined) on square grids with
@@ -158,6 +163,7 @@ def gemm_distributed(A, B, grid: ProcessGrid, method: str = "auto",
     return gemm_allgather(A, B, grid, precision)
 
 
+@instrument
 def gemm_padded(A: jax.Array, B: jax.Array, grid: ProcessGrid,
                 precision=lax.Precision.HIGHEST) -> jax.Array:
     """``gemm_distributed`` for arbitrary shapes: zero-pads both operands to

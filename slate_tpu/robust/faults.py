@@ -50,6 +50,19 @@ import jax.numpy as jnp
 
 from ..utils.trace import trace_event
 
+
+def count_event(name: str, **labels) -> None:
+    """Labeled robust-event counter on the obs registry, lazily imported and
+    exception-proof — the resilience layer's telemetry must be visible in
+    metrics.json but must never break (or import-couple) a solve.  Shared by
+    :func:`inject` and robust.policy's retry/fallback accounting."""
+    try:
+        from ..obs import counter
+        counter(name).inc(**labels)
+    except Exception:  # pragma: no cover - telemetry never breaks a solve
+        pass
+
+
 # injection points: where along a driver's lifetime a fault lands
 POINT_INPUT = "input"      # operand at driver entry
 POINT_FACTOR = "factor"    # low-precision / intermediate factor
@@ -223,4 +236,7 @@ def inject(driver: str, x, point: str = POINT_INPUT):
         x = _apply(spec, x, plan.seed)
         trace_event("fault_inject", driver=driver, kind=spec.kind,
                     point=point, call=spec.call_index)
+        # labeled counter: chaos runs surface faults in metrics.json
+        count_event("slate_robust_faults_injected_total",
+                    routine=driver, kind=spec.kind, point=point)
     return x

@@ -35,8 +35,8 @@ from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 from ..core.exceptions import ConvergenceError
-from ..utils.trace import trace_event
-from .faults import POINT_OUTPUT, active, inject
+from ..utils.trace import attempt_scope, trace_event
+from .faults import POINT_OUTPUT, active, count_event as _count, inject
 from .report import SolveReport
 
 
@@ -109,17 +109,25 @@ def run_ladder(routine: str, rungs: Sequence[Rung],
     """
     policy = policy or RetryPolicy()
     payload, ok = None, False
+    global_attempt = 0      # across rungs AND same-rung retries (the index
+    #                         trace.phase_attempts keys failed attempts by)
     for depth, rung in enumerate(rungs):
         if depth > 0:
             trace_event("fallback", routine=routine, to=rung.name)
+            _count("slate_robust_fallbacks_total", routine=routine,
+                   to=rung.name)
         for attempt in range(1 + max(policy.max_retries, 0)):
             if attempt > 0:
                 trace_event("retry", routine=routine, rung=rung.name,
                             attempt=attempt)
+                _count("slate_robust_retries_total", routine=routine,
+                       rung=rung.name)
                 _sleep(policy.backoff)
                 if report is not None:
                     report.retries += 1
-            payload, ok = rung.run()
+            with attempt_scope(routine, global_attempt):
+                payload, ok = rung.run()
+            global_attempt += 1
             if ok:
                 break
         if report is not None:
@@ -160,6 +168,8 @@ def guard_shards(routine: str, run: Callable[[], object],
             not bool(jnp.all(jnp.isfinite(X))):
         trace_event("retry", routine=routine, rung="shard_recover",
                     attempt=retries + 1)
+        _count("slate_robust_retries_total", routine=routine,
+               rung="shard_recover")
         _sleep(policy.backoff)
         X = inject(routine, run(), point=POINT_OUTPUT)
         retries += 1

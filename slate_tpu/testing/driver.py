@@ -85,6 +85,25 @@ def run_sweep(names: Sequence[str],
                         r.ref_time_s = _ref_time(routine, params)
                     r.params = dict(r.params, dtype=tletter)
                     results.append(r)
+                    _count_row(r, tletter)
                     if progress is not None:
                         progress(r)
     return results
+
+
+def _count_row(r: TestResult, tletter: str) -> None:
+    """Mirror each sweep row into the metrics registry (the tester's
+    contribution to the shared metrics.json: row counts by status, plus the
+    wall-time histogram the --timers side channel only printed before)."""
+    try:
+        from .. import obs
+
+        obs.counter("slate_tester_rows_total",
+                    "tester sweep rows by routine/status").inc(
+                        routine=r.routine, status=r.status, dtype=tletter)
+        if r.time_s is not None:
+            obs.histogram("slate_tester_row_seconds",
+                          "tester row wall time").observe(
+                              r.time_s, routine=r.routine, dtype=tletter)
+    except Exception:  # pragma: no cover - telemetry never fails a sweep
+        pass

@@ -11,6 +11,9 @@ BENCH_NOTES.md round 6) — they gate kernel SHAPE, not machine speed, so they
 hold on any backend.  All shapes compile in seconds on CPU.
 """
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -135,3 +138,39 @@ class TestPotrfPins:
             a).compile()
         flops = cost_analysis_dict(comp).get("flops", 0.0)
         assert flops <= 1.1 * n**3 / 3, flops / (n**3 / 3)
+
+
+class TestCollectivePins:
+    """Distributed collective-volume envelopes (the round-8 scaling gate):
+    every routine in the scaling-audit registry recompiles on a P=2 CPU mesh
+    and its compiled collective bytes/sites must stay inside the envelopes
+    pinned in SCALING_PINS.json (written by ``tools/gen_scaling.py
+    --update-pins``).  A schedule change that widens a gathered panel or
+    swaps a psum for an all-gather fails here — in CPU seconds — before a
+    capture window is spent, exactly like the flop/traffic pins above gate
+    the single-chip kernels."""
+
+    PINS_PATH = os.path.join(os.path.dirname(__file__), "..",
+                             "SCALING_PINS.json")
+
+    @pytest.fixture(scope="class")
+    def pins(self):
+        if not os.path.exists(self.PINS_PATH):
+            pytest.skip("SCALING_PINS.json not generated "
+                        "(run tools/gen_scaling.py --update-pins)")
+        with open(self.PINS_PATH) as f:
+            return json.load(f)
+
+    def test_p2_collective_volume_within_envelopes(self, pins):
+        """The gate itself: recompute the full P=2 audit and run it through
+        the same ``check_pins`` the CI scaling-audit step uses (one envelope
+        implementation, no drift).  Audited-but-unpinned routines fail too —
+        a shrunk pin file must not pass vacuously.  Failures list every
+        regressed routine, not just the first."""
+        from slate_tpu import obs
+        from slate_tpu.obs.scaling import check_pins
+
+        rows = obs.audit_all([pins.get("P", 2)])
+        bad = check_pins(rows, pins)
+        assert not bad, "collective-volume regressions:\n  " + \
+            "\n  ".join(bad)

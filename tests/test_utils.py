@@ -179,3 +179,106 @@ class TestPoolTracking:
 
         M = slate.Matrix.from_array(jnp.zeros((8, 8), jnp.float32), nb=4)
         assert getattr(M.storage, "pool", None) is None
+
+
+class TestTraceFinish:
+    """Satellite (round 8): trace.finish must be idempotent and safe under
+    trace.off() — a second call after a flush used to re-emit a truncated /
+    duplicate trace file."""
+
+    def test_finish_is_idempotent(self, tmp_path):
+        from slate_tpu.utils import trace
+
+        trace.on()
+        try:
+            with trace.trace_block("region_a"):
+                pass
+            p1 = str(tmp_path / "t1.json")
+            assert trace.finish(p1) == p1
+            import json
+            events = json.load(open(p1))["traceEvents"]
+            assert any(e["name"] == "region_a" for e in events)
+            # second call: nothing buffered -> no file, no duplicate
+            p2 = str(tmp_path / "t2.json")
+            assert trace.finish(p2) is None
+            import os
+            assert not os.path.exists(p2)
+        finally:
+            trace.off()
+
+    def test_finish_under_off_returns_none(self, tmp_path):
+        from slate_tpu.utils import trace
+
+        trace.off()
+        p = str(tmp_path / "off.json")
+        assert trace.finish(p) is None
+        import os
+        assert not os.path.exists(p)
+
+    def test_events_after_flush_start_fresh_buffer(self, tmp_path):
+        from slate_tpu.utils import trace
+
+        trace.on()
+        try:
+            with trace.trace_block("first"):
+                pass
+            trace.finish(str(tmp_path / "a.json"))
+            with trace.trace_block("second"):
+                pass
+            import json
+            pb = trace.finish(str(tmp_path / "b.json"))
+            names = [e["name"] for e in json.load(open(pb))["traceEvents"]]
+            assert names == ["second"]      # no replay of the flushed events
+        finally:
+            trace.off()
+
+
+class TestPhaseAttempts:
+    """Satellite (round 8): escalation-ladder retries must accumulate
+    per-attempt phase maps instead of clobbering (the failed attempt's
+    attribution is exactly what a post-mortem needs)."""
+
+    def test_ladder_keeps_failed_attempt_phases(self):
+        from slate_tpu.robust import Rung, run_ladder
+        from slate_tpu.utils import trace
+
+        def failing_rung():
+            tm = trace.Timers()
+            tm["panel"] = 2.0
+            trace.record_phases("inner_driver", tm)
+            return None, False
+
+        def winning_rung():
+            tm = trace.Timers()
+            tm["panel"] = 0.25
+            trace.record_phases("inner_driver", tm)
+            return "ok", True
+
+        out = run_ladder("t_ladder_phases",
+                         [Rung("fast", failing_rung),
+                          Rung("full", winning_rung)])
+        assert out == "ok"
+        attempts = trace.phase_attempts("t_ladder_phases")
+        assert attempts[0] == {"inner_driver.panel": 2.0}
+        assert attempts[1] == {"inner_driver.panel": 0.25}
+        # last_phases keeps its existing contract: the final attempt's map
+        assert trace.last_phases("inner_driver") == {"panel": 0.25}
+
+    def test_fresh_ladder_run_resets_attempt_history(self):
+        from slate_tpu.robust import Rung, run_ladder
+        from slate_tpu.utils import trace
+
+        def ok_rung():
+            trace.record_phases("d2", {"phase": 1.0})
+            return "x", True
+
+        run_ladder("t_ladder_reset", [Rung("a", ok_rung)])
+        run_ladder("t_ladder_reset", [Rung("a", ok_rung)])
+        attempts = trace.phase_attempts("t_ladder_reset")
+        assert list(attempts) == [0]        # second solve reset attempt 0
+
+    def test_plain_record_lands_under_attempt_zero(self):
+        from slate_tpu.utils import trace
+
+        trace.record_phases("t_plain", {"stage": 3.0})
+        assert trace.phase_attempts("t_plain") == {0: {"stage": 3.0}}

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Generate SCALING.md + pinned collective-volume envelopes for CI.
+
+The per-routine scaling artifact ROADMAP item 4 asks for: every distributed
+routine in ``slate_tpu/parallel`` compiled on CPU meshes at P ∈ {2, 4, 8}
+(compile-only — the same in-env discipline as tools/twostage_scale.py), with
+compiled collective volume, per-device flops/bytes, and the comm/compute
+ratio per row.  The P=2 collective columns are pinned into SCALING_PINS.json
+so a communication-volume regression fails CI (tests/test_perf_pins.py and
+the ci.yml ``scaling-audit`` step) before a capture window is spent.
+
+Usage::
+
+    python tools/gen_scaling.py                  # full table -> SCALING.md
+    python tools/gen_scaling.py --update-pins    # also refresh SCALING_PINS.json
+    python tools/gen_scaling.py --check          # P=2 only, diff vs pins, rc!=0
+                                                 # on regression (the CI gate)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from force_cpu import force_cpu_backend
+
+force_cpu_backend(virtual_devices=8)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MD_PATH = os.path.join(REPO, "SCALING.md")
+PINS_PATH = os.path.join(REPO, "SCALING_PINS.json")
+
+PINS_SCHEMA = "slate_tpu.scaling_pins/v1"
+#: regression envelope: measured collective bytes may grow to this factor of
+#: the pinned value before the gate trips (compiler-version jitter is a few
+#: percent; a schedule regression of the round-5 CALU kind is 2-3x)
+BYTES_SLACK = 1.25
+#: extra collective *sites* tolerated over the pin (fusion jitter)
+COUNT_SLACK = 2
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "-"
+    if b >= 1 << 20:
+        return f"{b / (1 << 20):.2f} MiB"
+    if b >= 1 << 10:
+        return f"{b / (1 << 10):.1f} KiB"
+    return f"{b} B"
+
+
+def _fmt_ratio(r) -> str:
+    return f"{r:.2e}" if r is not None else "-"
+
+
+def _collectives_cell(row) -> str:
+    ops = row.get("collectives") or {}
+    if not ops:
+        return "-"
+    return ", ".join(f"{op}×{e['count']}" for op, e in sorted(ops.items()))
+
+
+def render_markdown(rows, pset) -> str:
+    from slate_tpu.obs.scaling import AUDIT_KD, AUDIT_N, AUDIT_NB
+
+    lines = []
+    w = lines.append
+    w("# SCALING.md — per-routine distributed scaling audit")
+    w("")
+    w("Generated in-env by `python tools/gen_scaling.py` on a virtual CPU")
+    w(f"mesh (`--xla_force_host_platform_device_count`), P ∈ "
+      f"{{{', '.join(str(p) for p in pset)}}}, audit shape n={AUDIT_N} "
+      f"(nb={AUDIT_NB}, band kd={AUDIT_KD}, f32, compile-only — nothing "
+      "executes; the same XLA SPMD program a TPU mesh compiles).")
+    w("")
+    w("Columns: **coll bytes** = summed output bytes of every collective op")
+    w("in the compiled HLO (all-reduce / all-gather / reduce-scatter /")
+    w("all-to-all / collective-permute, async forms folded, per device,")
+    w("**static sites** — a collective inside a `while` loop counts once, so")
+    w("loop-carried schedules are lower bounds); **flops/dev, bytes/dev** =")
+    w("XLA `cost_analysis` of the partitioned module; **comm/compute** =")
+    w("collective bytes per device flop.  The audit gates the compiled")
+    w("*shape* of each program: a schedule change that widens a gathered")
+    w("panel or swaps a psum for an all-gather moves these columns at any")
+    w("problem size (the `kernel_plan` discipline of PR 2, generalized from")
+    w("Pallas launches to whole distributed programs).")
+    w("")
+    for nproc in pset:
+        w(f"## P = {nproc}")
+        w("")
+        w("| routine | module | grid | coll bytes | coll sites | collectives "
+          "| flops/dev | bytes/dev | comm/compute (B/flop) |")
+        w("|---|---|---|---|---|---|---|---|---|")
+        for row in rows:
+            if row["P"] != nproc:
+                continue
+            if row.get("skipped"):
+                w(f"| {row['routine']} | {row['module']} | {row['grid']} "
+                  f"| — | — | n/a ({row['skipped']}) | — | — | — |")
+                continue
+            if row.get("error"):
+                w(f"| {row['routine']} | {row['module']} | {row['grid']} "
+                  f"| — | — | ERROR: {row['error'][:80]} | — | — | — |")
+                continue
+            w(f"| {row['routine']} | {row['module']} | {row['grid']} "
+              f"| {_fmt_bytes(row['collective_bytes'])} "
+              f"| {row['collective_count']} "
+              f"| {_collectives_cell(row)} "
+              f"| {row['flops']:.3g} | {row['bytes_accessed']:.3g} "
+              f"| {_fmt_ratio(row['comm_compute_ratio'])} |")
+        w("")
+    w("## Scaling of collective volume with P")
+    w("")
+    w("| routine | " + " | ".join(f"P={p} coll bytes" for p in pset) + " |")
+    w("|---|" + "---|" * len(pset))
+    names = []
+    for row in rows:
+        if row["routine"] not in names:
+            names.append(row["routine"])
+    by_key = {(r["routine"], r["P"]): r for r in rows}
+    for name in names:
+        cells = []
+        for p in pset:
+            r = by_key.get((name, p), {})
+            cells.append(_fmt_bytes(r.get("collective_bytes"))
+                         if not (r.get("error") or r.get("skipped")) else "—")
+        w(f"| {name} | " + " | ".join(cells) + " |")
+    w("")
+    w("## Two-stage eigensolver at BASELINE scale (from TWOSTAGE_SCALE.md)")
+    w("")
+    w("The first scaling artifact this file supersedes covered only the")
+    w("two-stage path; its compiled `memory_analysis` numbers fold in here")
+    w("so one document carries the multi-chip evidence:")
+    w("")
+    folded = _fold_twostage()
+    lines.extend(folded)
+    w("")
+    w("## CI gate")
+    w("")
+    w(f"`SCALING_PINS.json` pins the P=2 collective columns; "
+      f"`tests/test_perf_pins.py::TestCollectivePins` and the ci.yml "
+      f"`scaling-audit` step recompute them and fail when measured bytes "
+      f"exceed {BYTES_SLACK}× the pin or the site count grows by more than "
+      f"{COUNT_SLACK} (`python tools/gen_scaling.py --check`).  Refresh pins "
+      "after an intentional schedule change with `--update-pins`.")
+    w("")
+    return "\n".join(lines)
+
+
+def _fold_twostage():
+    """Carry TWOSTAGE_SCALE.md's measured tables forward (satellite: fold the
+    first scaling artifact's numbers into the generated one)."""
+    path = os.path.join(REPO, "TWOSTAGE_SCALE.md")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return ["*(TWOSTAGE_SCALE.md not present in this checkout)*"]
+    # keep the tables + the peak-footprint verdict, drop the H1
+    keep = []
+    for line in text.splitlines():
+        if line.startswith("# "):
+            continue
+        keep.append(line.replace("## ", "### "))
+    return keep
+
+
+def build_pins(rows, nproc=2):
+    routines = {}
+    for row in rows:
+        if row["P"] != nproc or row.get("error") or row.get("skipped"):
+            continue
+        routines[row["routine"]] = {
+            "collective_bytes": int(row["collective_bytes"]),
+            "collective_count": int(row["collective_count"]),
+            "flops": float(row["flops"]),
+        }
+    from slate_tpu.obs.scaling import AUDIT_N, AUDIT_NB
+
+    return {"schema": PINS_SCHEMA, "P": nproc,
+            "audit_n": AUDIT_N, "audit_nb": AUDIT_NB,
+            "bytes_slack": BYTES_SLACK, "count_slack": COUNT_SLACK,
+            "routines": routines}
+
+
+def check_against_pins(rows, pins) -> int:
+    """Diff freshly audited P=2 rows against the pinned envelopes.  Returns
+    the number of regressions (0 = gate passes).  The envelope semantics
+    live in ``slate_tpu.obs.scaling.check_pins`` — one implementation shared
+    with tests/test_perf_pins.py so the two gates cannot drift."""
+    from slate_tpu.obs.scaling import check_pins
+
+    problems = check_pins(rows, pins)
+    for p in problems:
+        print(f"REGRESSION {p}")
+    return len(problems)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pset", default="2,4,8",
+                    help="comma list of device counts (default 2,4,8)")
+    ap.add_argument("--routines", default=None,
+                    help="comma list of routine names (default: all)")
+    ap.add_argument("--out", default=MD_PATH)
+    ap.add_argument("--json", default=None,
+                    help="also dump raw audit rows as JSON here")
+    ap.add_argument("--update-pins", action="store_true",
+                    help="refresh SCALING_PINS.json from the P=2 rows")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: audit P=2 only and diff against "
+                         "SCALING_PINS.json; exit nonzero on regression")
+    args = ap.parse_args(argv)
+
+    from slate_tpu.obs import scaling
+
+    if args.check and args.routines:
+        # the gate diffs against the FULL pin file; auditing a subset would
+        # report every unselected routine as a bogus regression
+        print("--check audits every pinned routine; drop --routines "
+              "(use --update-pins for a subset refresh)")
+        return 2
+    pset = [2] if args.check else sorted(
+        int(p) for p in args.pset.split(",") if p)
+    names = ([t for t in args.routines.split(",") if t]
+             if args.routines else None)
+
+    def progress(row):
+        msg = (row.get("error") or row.get("skipped")
+               or f"coll={_fmt_bytes(row['collective_bytes'])} "
+                  f"sites={row['collective_count']} "
+                  f"flops/dev={row['flops']:.3g}")
+        print(f"P={row['P']} {row['routine']:28s} {msg}", flush=True)
+
+    rows = scaling.audit_all(pset, names=names, progress=progress)
+
+    if args.check:
+        try:
+            with open(PINS_PATH) as f:
+                pins = json.load(f)
+        except OSError as e:
+            print(f"no pins at {PINS_PATH} ({e}); run --update-pins first")
+            return 2
+        bad = check_against_pins(rows, pins)
+        print(f"scaling-audit: {len(rows)} rows, {bad} regressions")
+        return 1 if bad else 0
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1, default=str)
+        print(f"wrote {args.json}")
+
+    with open(args.out, "w") as f:
+        f.write(render_markdown(rows, pset))
+    print(f"wrote {args.out}")
+
+    if args.update_pins:
+        pins = build_pins(rows, nproc=2)
+        if not pins["routines"]:
+            print(f"--update-pins: no P=2 rows audited (pset={pset}); "
+                  "refusing to write an empty pin file")
+            return 2
+        if args.routines:
+            # subset refresh: merge into the existing pin file — a partial
+            # run must never drop the other routines' envelopes
+            try:
+                with open(PINS_PATH) as f:
+                    prev = json.load(f)
+                merged = dict(prev.get("routines", {}))
+            except OSError:
+                merged = {}
+            merged.update(pins["routines"])
+            pins["routines"] = merged
+        with open(PINS_PATH, "w") as f:
+            json.dump(pins, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {PINS_PATH} ({len(pins['routines'])} routines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,11 @@ def main(argv=None) -> int:
     ap.add_argument("--timers", action="store_true",
                     help="print per-phase timer maps under eig/svd rows (the "
                          "reference tester's --timer-level 2)")
+    ap.add_argument("--metrics", nargs="?", const="metrics.json", default=None,
+                    metavar="PATH",
+                    help="dump the sweep's metrics.json (slate_tpu.obs "
+                         "registry: spans, phase histograms, tester row "
+                         "counters, robust events) — default ./metrics.json")
     ap.add_argument("--xml", default=None, help="write JUnit XML here")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--grid", default=None, metavar="PxQ",
@@ -140,6 +145,11 @@ def main(argv=None) -> int:
     nfail = len(results) - npass - nskip
     print(f"\n[{cls}] {len(results)} tests: {npass} pass, {nfail} failed, "
           f"{nskip} skipped in {elapsed:.1f}s")
+
+    if args.metrics:
+        from slate_tpu import obs
+
+        print(f"wrote {obs.export_metrics(args.metrics, source='tester')}")
 
     if args.xml:
         suite = ET.Element("testsuite", name=f"slate_tpu-{cls}",
