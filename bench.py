@@ -92,6 +92,15 @@ BASELINES = {
                           # (between the n=4096 150 and n=16384 300 rates);
                           # config exists to time the SLATE-parity two-stage
                           # pipeline next to the fused QDWH default
+    "serve_mixed": 20000.0,   # solves/s — nominal A100 batched-cuSOLVER
+                              # order-of-magnitude for mixed n<=96 small
+                              # solves (getrfBatched-class throughput); a
+                              # rough denominator documented so the ratio is
+                              # a trend line, not a hardware-parity claim.
+                              # This config's unit is solves/s, not GFLOP/s:
+                              # the serving axis measures throughput + p50/
+                              # p99 latency of the slate_tpu.serve queue
+                              # under synthetic mixed traffic (ROADMAP 2)
 }
 
 # ordered safest-first: a child killed mid-execution can wedge the
@@ -99,8 +108,9 @@ BASELINES = {
 # cheap/robust on hardware run before the risky ones (LU last: both the fused
 # and tournament paths are slow enough at n=16384 to risk the per-config
 # timeout)
-CONFIGS = ["gemm", "norm", "f64gemm", "potrf", "potrf_la", "gels", "gesvir",
-           "heev", "svd", "getrf", "getrf_pp", "heev2s", "svd2s"]
+CONFIGS = ["gemm", "norm", "serve_mixed", "f64gemm", "potrf", "potrf_la",
+           "gels", "gesvir", "heev", "svd", "getrf", "getrf_pp", "heev2s",
+           "svd2s"]
 HEADLINE = "gemm"
 
 # per-config child timeouts: the BASELINE-scale eig/SVD configs and the
@@ -723,8 +733,31 @@ def child_svd2s(cpu_fallback):
            "sec_per_call": sec, "phases_first_call": phases, **info})
 
 
+def child_serve_mixed(cpu_fallback):
+    """Mixed-traffic serving throughput (slate_tpu.serve; ROADMAP item 2's
+    new bench axis): ≥1000 small gesv/posv/gels requests across ≥4 shape
+    buckets through the async queue — solves/sec + p50/p99 latency, with
+    batch-occupancy and cache hit-rate riding in the metrics blob _emit
+    attaches.  Runs the same protocol on CPU and TPU (the problems are
+    small; the axis is queue+cache throughput, not peak flops): warm-up
+    compiles every (routine, bucket, batch-bucket) executable, then the
+    measured pass must take zero cache misses."""
+    from slate_tpu.serve.workload import run_mixed_workload
+
+    stats = run_mixed_workload(num_requests=1200, seed=0)
+    _emit({"metric": "serve_mixed_solves_per_sec",
+           "value": stats["solves_per_sec"], "unit": "solves/s",
+           "requests": stats["requests"], "wall_s": stats["wall_s"],
+           "p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"],
+           "distinct_buckets": stats["distinct_buckets"],
+           "routines": stats["routines"],
+           "misses_after_warmup": stats["misses_after_warmup"],
+           "cache": stats["cache"], "warmup": stats["warmup"]})
+
+
 CHILDREN = {
     "probe": lambda cpu: child_probe(),
+    "serve_mixed": child_serve_mixed,
     "norm": child_norm,
     "gemm": child_gemm,
     "potrf": child_potrf,

@@ -45,6 +45,8 @@ from .linalg import (bdsqr, cholqr, gbmm, gbsv, gbtrf, gbtrs, ge2tb, ge2tb_band,
 from . import robust
 from .robust import (FaultPlan, FaultSpec, RetryPolicy, SolveReport,
                      reduce_info)
+from . import serve
+from .serve import gels_batched, gesv_batched, posv_batched
 from . import simplified
 from . import matgen
 from . import native

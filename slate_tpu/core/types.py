@@ -300,6 +300,25 @@ class Options:
         kw = {k: _coerce_option(k, v) for k, v in kw.items()}
         return dataclasses.replace(self, **kw)
 
+    def cache_key(self) -> "tuple":
+        """Canonical, hashable identity of this option set — the Options leg
+        of the serving layer's compiled-executable cache key
+        (slate_tpu.serve.cache; BLASX keys its software cache the same way:
+        routine + shape + the knobs that change the generated code).
+
+        Two option sets that would trace to the same program map to the same
+        key: enums collapse to their string values, dtype-likes (``precision``
+        / ``factor_precision`` accept ``jnp.float32``, ``np.dtype``, or the
+        string name) collapse to the canonical dtype name, and defaulted
+        fields equal explicitly-passed identical values.  Fields are emitted
+        in declaration order as ``(name, str)`` pairs, so the key is stable
+        across processes (no ``hash()`` randomization, no object ids)."""
+        parts = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            parts.append((f.name, _canon_option_value(v)))
+        return tuple(parts)
+
     @classmethod
     def make(cls, opts: "Options | Dict[str, Any] | None") -> "Options":
         if opts is None:
@@ -329,3 +348,28 @@ def _coerce_option(key: str, value: Any) -> Any:
     if cls is not None and not isinstance(value, cls):
         return cls.from_string(value)
     return value
+
+
+def _canon_option_value(v: Any) -> str:
+    """One field value -> canonical string (see Options.cache_key)."""
+    if v is None:
+        return ""
+    if isinstance(v, _StrEnum):
+        return str(v)
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float, str)) and not isinstance(v, bool):
+        # "float32" the string should canonicalize like the dtype it names
+        if isinstance(v, str):
+            try:
+                import numpy as _np
+                return _np.dtype(v).name
+            except TypeError:
+                return v
+        return repr(v)
+    # dtype-likes: jnp.float32 (a type), np.dtype, np.float32, ...
+    try:
+        import numpy as _np
+        return _np.dtype(v).name
+    except TypeError:
+        return str(v)

@@ -1,12 +1,13 @@
 """Driver algorithms (reference L4, src/*.cc)."""
 
-from .chol import (posv, posv_mixed, posv_mixed_gmres, potrf, potri, potrs, trtri,
-                   trtrm)
-from .lu import (gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
+from .chol import (posv, posv_core, posv_mixed, posv_mixed_gmres, potrf, potri,
+                   potrs, trtri, trtrm)
+from .lu import (gerbt, gesv, gesv_core, gesv_mixed, gesv_mixed_gmres,
+                 gesv_nopiv, gesv_rbt,
                  getrf, getrf_nopiv, getrf_tntpiv, getri, getri_oop, getrs,
                  getrs_nopiv, perm_to_pivots, pivots_to_perm, rbt_generate)
-from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_qr,
-                 geqrf, tsqr, unmlq, unmqr)
+from .qr import (TriangularFactors, cholqr, gelqf, gels, gels_cholqr, gels_core,
+                 gels_qr, geqrf, tsqr, unmlq, unmqr)
 # the submodule import must come first: importing .stedc binds the module
 # object onto the package as attribute "stedc", and the .eig import below
 # re-binds that name to the driver *function* (the public contract)

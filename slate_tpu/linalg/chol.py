@@ -256,6 +256,21 @@ def potrf(A, opts=None, uplo=None):
     return out, info
 
 
+def posv_core(a, b):
+    """Pure single-matrix posv kernel: fused Cholesky + the two triangular
+    sweeps — no wrappers, injection, tracing, or host syncs.  Expects the
+    *full* Hermitian matrix (the serving layer hands in dense operands, not
+    half-stored wrappers).  vmap-compatible: :mod:`slate_tpu.serve` maps this
+    over a leading batch axis.  Returns ``(x, info)`` with the per-matrix
+    LAPACK info from the factor diagonal."""
+    L = lax.linalg.cholesky(a, symmetrize_input=False)
+    info = _chol_info(L)
+    y = lax.linalg.triangular_solve(L, b, left_side=True, lower=True)
+    x = lax.linalg.triangular_solve(L, y, left_side=True, lower=True,
+                                    conjugate_a=True, transpose_a=True)
+    return x, info
+
+
 def potrs(A, B, opts=None, uplo=None):
     """Solve A X = B given the Cholesky factor (src/potrs.cc: two work::trsm calls)."""
     opts = Options.make(opts)

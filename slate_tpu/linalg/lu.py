@@ -475,6 +475,20 @@ def lu_factored_solve(plu, perm, rhs):
     return lax.linalg.triangular_solve(plu, y, left_side=True, lower=False)
 
 
+def gesv_core(a, b):
+    """Pure single-matrix gesv kernel: partially-pivoted LU + the two
+    triangular sweeps, nothing else — no wrappers, no fault injection, no
+    trace blocks, no host syncs.  This is the vmap-first core the batched
+    serving layer (:mod:`slate_tpu.serve`) maps over a leading batch axis
+    (``lax.linalg.lu`` batches natively, so ``jax.vmap(gesv_core)`` is one
+    fused batched program).  Returns ``(x, perm, info)`` with a per-matrix
+    LAPACK info from the U diagonal."""
+    plu, _, perm = lax.linalg.lu(a)
+    info = _lu_info(jnp.diagonal(plu, axis1=-2, axis2=-1))
+    x = lu_factored_solve(plu, perm, b)
+    return x, perm, info
+
+
 def getrs(LU, perm, B, opts=None, trans=False):
     """Solve op(A) X = B from the LU factor (src/getrs.cc: permuteRows(Forward) +
     work::trsm(L) + work::trsm(U); here: one gather + two TriangularSolves).

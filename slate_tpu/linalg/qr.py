@@ -341,6 +341,58 @@ def _gels_csne(a, b):
     return lax.cond(bad, qr_path, lambda _: x, None)
 
 
+def gels_core(a, b):
+    """Pure least-squares kernel — no wrappers, injection, tracing, or host
+    syncs; the vmap-first core the batched serving layer maps over a leading
+    batch axis.  The tall/square path is *raw* CSNE — deliberately WITHOUT
+    :func:`_gels_csne`'s in-trace Householder escape: under ``vmap`` a
+    ``lax.cond`` lowers to a select that executes BOTH branches for every
+    batch element, so the escape would make every healthy batch pay a full
+    batched Householder QR.  The escape lives in the serving layer's
+    element-granular ladder instead (a failed element re-runs alone through
+    the full :func:`gels` driver, escape included).  The wide path is the LQ
+    minimum-norm solve expressed through QR of ``a^H``.  The branch is
+    static on shape, so every element of a shape bucket traces one program.
+
+    Returns ``(x, info)`` with x ``(n, nrhs)`` and info 0 on success,
+    nonzero when the Gram Cholesky broke (its 1-based pivot index) or the
+    solution is non-finite — the health verdict the escalation ladder keys
+    on (least squares has no LAPACK pivot semantics beyond that).
+    """
+    from ..ops.blas3 import gram as _gram
+    from .chol import _chol_blocked as _cb, _chol_info as _ci
+
+    m, n = a.shape[-2:]
+    if m >= n:
+        ah = jnp.conj(jnp.swapaxes(a, -1, -2))
+        G = _gram(a)
+        L = _cb(G)
+        ginfo = _ci(L)
+
+        def normal_solve(rhs):
+            y = lax.linalg.triangular_solve(L, rhs, left_side=True,
+                                            lower=True)
+            return lax.linalg.triangular_solve(L, y, left_side=True,
+                                               lower=True, conjugate_a=True,
+                                               transpose_a=True)
+
+        x = normal_solve(jnp.matmul(ah, b, precision=lax.Precision.HIGHEST))
+        r = b - jnp.matmul(a, x, precision=lax.Precision.HIGHEST)
+        x = x + normal_solve(jnp.matmul(ah, r,
+                                        precision=lax.Precision.HIGHEST))
+    else:
+        # minimum-norm via QR of a^H: a = R^H Q^H, x = Q R^{-H} b
+        q, r = lax.linalg.qr(jnp.conj(jnp.swapaxes(a, -1, -2)),
+                             full_matrices=False)
+        y = lax.linalg.triangular_solve(r, b, left_side=True, lower=False,
+                                        transpose_a=True, conjugate_a=True)
+        x = jnp.matmul(q, y, precision=lax.Precision.HIGHEST)
+        ginfo = jnp.int32(0)
+    info = jnp.where(jnp.all(jnp.isfinite(x)), ginfo,
+                     jnp.maximum(ginfo, jnp.int32(1)))
+    return x, info
+
+
 @instrument
 def gels(A, BX, opts=None):
     """Least squares min ||A X - B|| / minimum-norm solve (src/gels.cc dispatch:

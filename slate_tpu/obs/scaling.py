@@ -74,6 +74,23 @@ def _spd(n: int):
     return jnp.asarray(g @ g.T + n * np.eye(n), dtype=_DTYPE)
 
 
+def _randn_batch(b: int, m: int, n: int):
+    import jax.numpy as jnp
+
+    a = _rng(b * 17 + m).standard_normal((b, m, n))
+    if m == n:
+        a = a + m * np.eye(m)      # diagonally dominant: well-posed solves
+    return jnp.asarray(a, dtype=_DTYPE)
+
+
+def _spd_batch(b: int, n: int):
+    import jax.numpy as jnp
+
+    g = _rng(b * 31 + n).standard_normal((b, n, n))
+    return jnp.asarray(g @ np.swapaxes(g, -1, -2) + n * np.eye(n),
+                       dtype=_DTYPE)
+
+
 def _aot(fn, *args):
     """AOT-compile ``fn(*args)`` (compile-only: nothing executes)."""
     import jax
@@ -89,9 +106,9 @@ def _build_specs() -> List[RoutineSpec]:
     """The audit table.  Imports live inside the builders so ``import
     slate_tpu.obs`` stays jax-light; every builder closes over nothing but
     the grid handed to it."""
-    from ..parallel import (band_dist, blas3_dist, chase_dist, eig_dist,
-                            indefinite_dist, inverse, lu_dist, pipeline,
-                            qr_dist, rbt, secular, solvers, summa)
+    from ..parallel import (band_dist, batched, blas3_dist, chase_dist,
+                            eig_dist, indefinite_dist, inverse, lu_dist,
+                            pipeline, qr_dist, rbt, secular, solvers, summa)
 
     n, nb, kd = AUDIT_N, AUDIT_NB, AUDIT_KD
     mt = 4 * n                     # tall-panel audit height
@@ -263,6 +280,21 @@ def _build_specs() -> List[RoutineSpec]:
             lambda g: _aot(lambda a: pipeline.potrf_pipelined(a, g, nb=nb),
                            _spd(n)),
             model_flops=n**3 / 3),
+        # -- batched (serving tier) ------------------------------------------
+        # batch=16 divides every grid in P ∈ {2,4,8}; the audited fact is
+        # that the batch tier compiles with ZERO collectives — independent
+        # problems shard perfectly, the one routine whose communication
+        # envelope is identically nothing
+        RoutineSpec(
+            "gesv_batched_distributed", "batched",
+            lambda g: _aot(lambda a, b: batched.gesv_batched_distributed(
+                a, b, g), _randn_batch(16, nb, nb), _randn_batch(16, nb, 4)),
+            model_flops=16 * (2 * nb**3 / 3 + 2 * nb * nb * 4)),
+        RoutineSpec(
+            "posv_batched_distributed", "batched",
+            lambda g: _aot(lambda a, b: batched.posv_batched_distributed(
+                a, b, g), _spd_batch(16, nb), _randn_batch(16, nb, 4)),
+            model_flops=16 * (nb**3 / 3 + 2 * nb * nb * 4)),
     ]
     return specs
 

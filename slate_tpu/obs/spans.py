@@ -101,7 +101,8 @@ def _shape_bucket(shape) -> str:
     return f"<={b}"
 
 
-_LABEL_KWARGS = ("nb", "method", "lu_panel", "kind", "uplo", "lookahead")
+_LABEL_KWARGS = ("nb", "method", "lu_panel", "kind", "uplo", "lookahead",
+                 "batch", "bucket")
 
 
 def _derive_labels(args, kwargs) -> Dict[str, Any]:

@@ -50,3 +50,4 @@ from .indefinite_dist import (hetrf_distributed, hetrs_distributed,
                               hesv_distributed, HermitianFactorsDist)
 from .rbt import getrf_nopiv_distributed, gesv_rbt_distributed
 from .pipeline import potrf_pipelined
+from .batched import gesv_batched_distributed, posv_batched_distributed

@@ -21,10 +21,12 @@ Three parts (see README.md "Failure handling & fault injection"):
 from .faults import (FaultPlan, FaultSpec, POINT_FACTOR, POINT_INPUT,
                      POINT_OUTPUT, active, inject)
 from .policy import LADDERS, RetryPolicy, Rung, guard_shards, run_ladder
-from .report import SolveReport, first_bad_index, reduce_info
+from .report import (SolveReport, first_bad_index, first_bad_index_batched,
+                     reduce_info)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "POINT_FACTOR", "POINT_INPUT", "POINT_OUTPUT",
     "active", "inject", "LADDERS", "RetryPolicy", "Rung", "guard_shards",
-    "run_ladder", "SolveReport", "first_bad_index", "reduce_info",
+    "run_ladder", "SolveReport", "first_bad_index", "first_bad_index_batched",
+    "reduce_info",
 ]

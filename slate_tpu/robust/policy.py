@@ -76,6 +76,13 @@ LADDERS = {
     "posv_mixed_distributed": ("mixed", "full"),
     "gesv_mixed_distributed": ("mixed", "full"),
     "gesv_rbt_distributed": ("rbt", "partialpiv"),
+    # batched serving drivers (slate_tpu.serve): the whole batch solves on
+    # rung 1; only the elements whose per-request info/finiteness verdict
+    # failed re-run — one element at a time, from the pristine operand,
+    # re-entering the injection site so transient faults clear
+    "gesv_batched": ("batched", "elementwise"),
+    "posv_batched": ("batched", "elementwise"),
+    "gels_batched": ("batched", "elementwise"),
     # in-trace (lax.cond) ladders — documented here, executed inside jit:
     "cholqr": ("cholqr", "shifted_cholqr", "householder"),
     "gels_cholqr": ("csne", "householder"),

@@ -36,6 +36,22 @@ def first_bad_index(bad) -> "jnp.ndarray":
                      jnp.argmax(bad).astype(jnp.int32) + 1, jnp.int32(0))
 
 
+def first_bad_index_batched(bad) -> "jnp.ndarray":
+    """Per-element LAPACK info for a batched failure mask.
+
+    ``bad`` is ``(batch, n)``; returns ``(batch,)`` int32 with each element's
+    1-based first-True index (0 when clean) — :func:`first_bad_index` with the
+    reduction confined to the trailing axis, so one batched factorization
+    yields one info code *per request* (the serving layer's contract: a
+    poisoned element reports its own pivot index and its siblings report 0).
+    jit-safe; equivalent to ``jax.vmap(first_bad_index)`` but usable inside
+    programs that are themselves already batched."""
+    bad = jnp.asarray(bad)
+    return jnp.where(jnp.any(bad, axis=-1),
+                     jnp.argmax(bad, axis=-1).astype(jnp.int32) + 1,
+                     jnp.int32(0))
+
+
 def reduce_info(*infos) -> "jnp.ndarray":
     """Combine per-stage info codes; the first nonzero (in argument order) wins.
 

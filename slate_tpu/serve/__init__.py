@@ -1,0 +1,76 @@
+"""slate_tpu.serve — the batched solver service (throughput tier).
+
+SLATE's layer map reserves a batch-BLAS tier (PAPER.md L1) this repo never
+reproduced: every driver took one ``Matrix``.  This package is that tier
+rebuilt for serving — the north-star scenario of millions of small solves
+rather than one n=16384 factorization.  Three layers (BLASX, PAPERS.md, is
+the exemplar: a software cache + scheduler over heterogeneous executors):
+
+* **Batched drivers** (:mod:`.batched`): ``gesv_batched`` / ``posv_batched``
+  / ``gels_batched`` — vmap-first cores (``linalg.gesv_core`` et al.) with
+  per-request ``info`` / :class:`~slate_tpu.robust.SolveReport` extraction
+  and element-granular escalation ladders (only failed batch elements
+  re-run; siblings stay bit-identical).
+* **Executable cache** (:mod:`.cache`): AOT-compiled programs keyed by
+  ``(routine, shape bucket, batch size, dtype, Options.cache_key())``, with
+  warm-up API and hit/miss/evict counters in the obs registry — zero
+  compiles in steady state, CI-pinned.
+* **Serving queue** (:mod:`.queue`): :class:`BucketPolicy` (shape bucketing
+  + solution-preserving padding), :class:`ServeQueue` (async mixed-traffic
+  packing on max-batch / max-wait-ms), and the synchronous
+  :func:`solve_many` packer; :mod:`.workload` generates synthetic mixed
+  traffic and measures solves/sec + p50/p99 for bench + CI smoke.
+
+Verb-style usage (the simplified_api.hh idiom)::
+
+    from slate_tpu import serve
+    t = serve.submit("gesv", a, b)          # async, default queue
+    x, info = t.result()
+    results = serve.solve_many([("posv", a1, b1), ("gels", a2, b2)])
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .batched import gels_batched, gesv_batched, posv_batched
+from .cache import ExecutableCache, default_cache, reset_cache
+from .queue import (BucketPolicy, ServeQueue, Ticket, pad_request,
+                    solve_many, unpad_result)
+from .workload import make_requests, run_mixed_workload
+
+__all__ = [
+    "gesv_batched", "posv_batched", "gels_batched",
+    "ExecutableCache", "default_cache", "reset_cache",
+    "BucketPolicy", "ServeQueue", "Ticket", "pad_request", "unpad_result",
+    "solve_many", "make_requests", "run_mixed_workload",
+    "submit", "default_queue", "shutdown",
+]
+
+_QUEUE: Optional[ServeQueue] = None
+_QUEUE_LOCK = threading.Lock()
+
+
+def default_queue() -> ServeQueue:
+    """The process-wide serving queue (created on first use)."""
+    global _QUEUE
+    with _QUEUE_LOCK:
+        if _QUEUE is None:
+            _QUEUE = ServeQueue()
+        return _QUEUE
+
+
+def submit(routine: str, a, b) -> Ticket:
+    """Submit one solve to the default queue; returns a :class:`Ticket`
+    (``.result()`` blocks for ``(x, info)``)."""
+    return default_queue().submit(routine, a, b)
+
+
+def shutdown() -> None:
+    """Drain and stop the default queue (tests / process teardown)."""
+    global _QUEUE
+    with _QUEUE_LOCK:
+        if _QUEUE is not None:
+            _QUEUE.close()
+        _QUEUE = None

@@ -1,0 +1,252 @@
+"""Batched solve drivers: a leading batch dimension as a first-class axis.
+
+Reference analogue: SLATE's layer map reserves a whole batch-BLAS tier
+(PAPER.md L1) that the single-``Matrix`` drivers never exposed.  These
+drivers close that gap for the hot solves — ``gesv`` / ``posv`` / ``gels`` —
+by vmapping the pure cores (:func:`slate_tpu.linalg.gesv_core` /
+``posv_core`` / ``gels_core``) over a leading batch axis and compiling the
+result through the executable cache (:mod:`.cache`), so a million small
+solves is one executable call per packed batch, not a million dispatches.
+
+Health semantics (the part a naive ``vmap`` gets wrong):
+
+* **Per-request info.**  Every driver returns an ``info`` *vector* — element
+  i's LAPACK code comes from element i's factor alone (the batched form of
+  ``robust.first_bad_index``; here via ``vmap`` of the single-matrix info
+  kernels).  A poisoned element reports its own pivot index; its siblings
+  report 0 and their results are bit-identical to a clean batch's.
+* **Element-granular escalation.**  When ``Options.use_fallback_solver``
+  holds (the default), elements whose verdict failed re-run *alone* under
+  the declared ladder (robust.LADDERS["<routine>"]: batched → elementwise),
+  re-entering the fault-injection site from the pristine operand — so a
+  transient injected fault clears on the re-run, and one bad request never
+  costs its batchmates a recompute.
+* **Per-request reports.**  ``Options(solve_report=True)`` appends a list of
+  :class:`~slate_tpu.robust.SolveReport`, one per element, each carrying its
+  own info / fallback chain / recovered verdict.
+
+Fault-injection addressing: with a :class:`~slate_tpu.robust.FaultPlan`
+active, the batched drivers pass each element through
+``inject(routine, ...)`` individually, so ``FaultSpec(call_index=i)``
+targets element i of the first batched call (and re-runs advance the
+counter past the batch, making call_index < batch faults transient by
+construction).  With no plan active the whole batch passes through as one
+zero-overhead call.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import slate_assert
+from ..core.matrix import as_array, write_back
+from ..core.types import Options
+from ..linalg.chol import posv_core
+from ..linalg.lu import gesv_core
+from ..linalg.qr import gels as _gels_full, gels_core
+from ..obs import instrument
+from ..robust import (RetryPolicy, Rung, SolveReport, active, inject,
+                      run_ladder)
+from ..robust.faults import count_event
+from ..utils.trace import trace_event
+from .cache import ExecutableCache, default_cache
+
+#: routine name -> pure single-matrix core (the vmapped rung-1 program)
+CORES = {
+    "gesv_batched": gesv_core,
+    "posv_batched": posv_core,
+    "gels_batched": gels_core,
+}
+
+
+def _gels_elem(a, b):
+    """Elementwise-rung gels: the FULL driver (CSNE + in-trace Householder
+    escape + rank-deficiency clamp) — affordable here because only failed
+    elements take this path, one at a time, outside the vmapped program
+    (where the escape's lax.cond would cost every element both branches)."""
+    x = as_array(_gels_full(a, b))
+    info = jnp.where(jnp.all(jnp.isfinite(x)), jnp.int32(0), jnp.int32(1))
+    return x, info
+
+
+#: routine name -> the stronger single-matrix form the elementwise rung runs
+ELEM_CORES = {
+    "gesv_batched": gesv_core,      # partial pivoting is already the
+    "posv_batched": posv_core,      # strongest form for these two; the
+    #                                 re-run's value is the pristine operand
+    "gels_batched": _gels_elem,     # full escape ladder for least squares
+}
+
+
+def _inject_each(routine: str, a: jax.Array) -> jax.Array:
+    """Element-wise injection boundary (see module docstring).  Zero-overhead
+    when no plan is active: one ``active()`` check, no per-element calls."""
+    if active() is None:
+        return a
+    return jnp.stack([inject(routine, a[i]) for i in range(a.shape[0])])
+
+
+def _as_batch(A, B, routine: str):
+    a = as_array(A)
+    b = as_array(B)
+    slate_assert(a.ndim == 3, f"{routine}: A must be (batch, m, n), "
+                              f"got shape {a.shape}")
+    squeeze = b.ndim == 2
+    if squeeze:
+        b = b[..., None]
+    slate_assert(b.ndim == 3 and b.shape[0] == a.shape[0]
+                 and b.shape[1] == a.shape[1],
+                 f"{routine}: B must be (batch, m[, nrhs]) conformal with A, "
+                 f"got A {a.shape}, B {b.shape}")
+    return a, b, squeeze
+
+
+def batched_build(routine: str) -> Callable:
+    """The ONE builder the executable cache compiles for ``routine``.
+
+    ``ExecutableCache.make_key`` does not fold in function identity, so every
+    site that compiles under a routine's key (the drivers here, the queue's
+    ``warmup`` sweep) MUST use this factory — a second hand-rolled copy that
+    drifted would let warm traffic key-match a stale program."""
+    core = CORES[routine]
+
+    def build(a_, b_):
+        return jax.vmap(core)(a_, b_)
+
+    return build
+
+
+def _run_batched(routine: str, a, b, opts: Options,
+                 cache: Optional[ExecutableCache], donate: bool):
+    """The rung-1 batch solve: vmapped core through the executable cache."""
+    cache = default_cache() if cache is None else cache
+    ex = cache.get(routine, batched_build(routine), (a, b), opts,
+                   donate=donate)
+    return ex(a, b)
+
+
+def _finite_mask(x) -> np.ndarray:
+    """Host bool per element: all entries finite."""
+    return np.asarray(jnp.all(jnp.isfinite(x), axis=tuple(range(1, x.ndim))))
+
+
+def _escalate(routine: str, core: Callable, a0, b, idx: Sequence[int],
+              opts: Options, out_arrays: List, info, reports):
+    """Re-run the failed elements one by one under the declared ladder.
+
+    ``out_arrays`` are the per-routine payload arrays (x [, perm]); patched
+    in place (functionally) for each recovered element.  Returns the updated
+    ``(out_arrays, info)``."""
+    policy = RetryPolicy.from_options(opts, routine)
+    for i in idx:
+        trace_event("fallback", routine=routine, to="elementwise", elem=int(i))
+        count_event("slate_robust_fallbacks_total", routine=routine,
+                    to="elementwise")
+        state = {}
+
+        def elem_rung(i=i):
+            ai = inject(routine, a0[i])   # pristine operand, counter advances
+            out = core(ai, b[i])
+            einfo = out[-1]
+            ok = bool((einfo == 0)
+                      & jnp.all(jnp.isfinite(as_array(out[0]))))
+            state["out"] = out
+            return out, ok
+
+        report = reports[i] if reports is not None else None
+        run_ladder(routine, [Rung("elementwise", elem_rung)], policy, report)
+        out = state["out"]
+        for slot, val in zip(out_arrays, out[:-1]):
+            slot[0] = slot[0].at[i].set(val)
+        info = info.at[i].set(out[-1])
+    return out_arrays, info
+
+
+def _solve_batched(routine: str, A, B, opts, cache, donate):
+    """Shared driver body; returns (payload tuple, info[, reports])."""
+    opts = Options.make(opts)
+    a0, b, squeeze = _as_batch(A, B, routine)
+    batch = a0.shape[0]
+    a = _inject_each(routine, a0)
+    want_verdict = (opts.use_fallback_solver or opts.solve_report
+                    or active() is not None)
+    # donation invalidates the operand buffers, and the verdict/escalation
+    # path re-reads them (a0[i] on re-run) — so donation is only honored on
+    # the zero-sync fast path where nothing is read back after execution
+    out = _run_batched(routine, a, b, opts, cache,
+                       donate and not want_verdict)
+    payload, info = list(out[:-1]), out[-1]
+
+    reports = None
+    if opts.solve_report:
+        reports = [SolveReport(routine=routine,
+                               precision_used=str(a0.dtype),
+                               fallback_chain=("batched",))
+                   for _ in range(batch)]
+    if want_verdict:
+        # the batch's single host sync: per-element info + finiteness
+        bad = (np.asarray(info) != 0) | ~_finite_mask(payload[0])
+        failed = [int(i) for i in np.nonzero(bad)[0]]
+        if failed and opts.use_fallback_solver:
+            slots = [[p] for p in payload]
+            slots, info = _escalate(routine, ELEM_CORES[routine], a0, b,
+                                    failed, opts, slots, info, reports)
+            payload = [s[0] for s in slots]
+        elif reports is not None:
+            for i in failed:
+                reports[i].recovered = False
+    if reports is not None:
+        final = np.asarray(info)
+        for i, r in enumerate(reports):
+            r.info = int(final[i])
+            if len(r.fallback_chain) == 1:      # never escalated
+                r.recovered = r.info == 0
+            r.finalize()
+    x = payload[0][..., 0] if squeeze else payload[0]
+    x = write_back(B, x) if x.shape == as_array(B).shape else x
+    payload[0] = x
+    return payload, info, reports
+
+
+@instrument
+def gesv_batched(A, B, opts=None, cache=None, donate=False):
+    """Batched ``gesv``: solve ``A[i] X[i] = B[i]`` for a (batch, n, n) stack.
+
+    Returns ``(X, perm, info)`` with ``perm`` (batch, n) and ``info``
+    (batch,) int32 per-request codes; with ``Options(solve_report=True)``,
+    ``(X, perm, info, reports)`` where ``reports`` is one
+    :class:`SolveReport` per element.  See the module docstring for the
+    escalation and fault-injection semantics."""
+    payload, info, reports = _solve_batched("gesv_batched", A, B, opts,
+                                            cache, donate)
+    x, perm = payload
+    return (x, perm, info) if reports is None else (x, perm, info, reports)
+
+
+@instrument
+def posv_batched(A, B, opts=None, cache=None, donate=False):
+    """Batched SPD solve: ``A[i] X[i] = B[i]`` with each A[i] the *full*
+    Hermitian matrix.  Returns ``(X, info)``; with
+    ``Options(solve_report=True)``, ``(X, info, reports)``."""
+    payload, info, reports = _solve_batched("posv_batched", A, B, opts,
+                                            cache, donate)
+    return (payload[0], info) if reports is None else \
+        (payload[0], info, reports)
+
+
+@instrument
+def gels_batched(A, B, opts=None, cache=None, donate=False):
+    """Batched least squares: min ‖A[i] X[i] − B[i]‖ over a (batch, m, n)
+    stack (tall/square = CSNE with Householder escape; wide = LQ min-norm —
+    the shape class is static per bucket).  Returns ``(X, info)`` with X
+    (batch, n, nrhs); with ``Options(solve_report=True)``,
+    ``(X, info, reports)``."""
+    payload, info, reports = _solve_batched("gels_batched", A, B, opts,
+                                            cache, donate)
+    return (payload[0], info) if reports is None else \
+        (payload[0], info, reports)

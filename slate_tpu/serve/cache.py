@@ -1,0 +1,183 @@
+"""Compiled-executable cache: the software-cache tier of the serving layer.
+
+Reference analogue: none in SLATE — the exemplar is BLASX (PAPERS.md), a
+throughput-oriented L3 BLAS built as a software cache plus a scheduler over
+heterogeneous executors.  Here the "tiles" being cached are *compiled XLA
+executables*: an AOT-compiled batched solve program keyed by
+
+    (routine, shape bucket, batch size, dtype, Options.cache_key())
+
+so that steady-state mixed traffic never re-traces or re-compiles — every
+request that lands in a warm bucket goes straight to ``Compiled.__call__``.
+``jax.jit`` keeps its own trace cache, but it is keyed by Python callable
+identity and silently re-traces when wrappers are rebuilt; this cache owns
+the keying explicitly, counts every hit/miss/eviction in the obs registry
+(``slate_serve_cache_*``), and makes "zero compiles after warm-up" a
+CI-checkable property instead of a hope (tests/test_serve.py pins it).
+
+Donation: ``donate=True`` compiles with input buffers donated back to XLA,
+so steady-state serving reuses allocations instead of growing the heap.  It
+is honored only off-CPU (CPU XLA ignores donation and would warn per call),
+and the batched drivers additionally restrict it to the zero-sync fast path
+(``use_fallback_solver=False``, no report, no chaos) — the verdict/
+escalation path re-reads the operands after execution, which donated
+buffers would invalidate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from ..core.types import Options
+
+
+def _counter(name: str, help: str = ""):
+    from .. import obs
+
+    return obs.counter(name, help)
+
+
+class ExecutableCache:
+    """LRU cache of AOT-compiled batched solve executables.
+
+    ``get(routine, build, args, opts)`` returns a callable: on a hit, the
+    stored ``jax.stages.Compiled``; on a miss, ``build`` is traced + compiled
+    for the abstract shapes/dtypes of ``args`` (nothing executes at compile
+    time) and the executable is stored.  Keys fold in ``Options.cache_key()``
+    so two option sets that would generate different programs never share an
+    executable.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._table: "OrderedDict[tuple, Any]" = OrderedDict()
+        # plain-int mirror of the obs counters: tests and the smoke gate read
+        # these without label arithmetic; the obs registry carries the same
+        # events with routine/bucket labels for metrics.json
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- keying --------------------------------------------------------------
+    @staticmethod
+    def make_key(routine: str, args: Sequence[Any],
+                 opts: Optional[Options], donate: bool) -> tuple:
+        shapes = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        okey = (Options.make(opts).cache_key() if not isinstance(opts, tuple)
+                else opts)
+        return (routine, shapes, okey, bool(donate))
+
+    @staticmethod
+    def _labels(routine: str, args: Sequence[Any]) -> Dict[str, str]:
+        lead = args[0]
+        bucket = "x".join(str(d) for d in lead.shape[1:]) if lead.shape else ""
+        return {"routine": routine, "bucket": bucket,
+                "batch": str(lead.shape[0] if lead.shape else 0),
+                "dtype": str(lead.dtype)}
+
+    # -- the cache -----------------------------------------------------------
+    def get(self, routine: str, build: Callable, args: Sequence[Any],
+            opts: Optional[Options] = None, donate: bool = False):
+        """The compiled executable for ``build`` at ``args``'s shapes.
+
+        ``build`` must be a pure function of ``args`` (the batched cores);
+        it is only traced on a miss.  ``donate`` requests input-buffer
+        donation (honored off-CPU only — CPU XLA ignores donation and would
+        warn on every call)."""
+        import jax
+
+        if donate and jax.default_backend() == "cpu":
+            donate = False
+        key = self.make_key(routine, args, opts, donate)
+        labels = self._labels(routine, args)
+        with self._lock:
+            ex = self._table.get(key)
+            if ex is not None:
+                self._table.move_to_end(key)
+                self.hits += 1
+                _counter("slate_serve_cache_hits_total",
+                         "executable-cache hits").inc(**labels)
+                return ex
+            self.misses += 1        # counted under the lock, like hits
+        # compile outside the lock: a long XLA compile must not serialize
+        # unrelated buckets' lookups
+        _counter("slate_serve_cache_misses_total",
+                 "executable-cache misses (one compile each)").inc(**labels)
+        t0 = time.perf_counter()
+        jit = jax.jit(build, donate_argnums=tuple(range(len(args)))
+                      if donate else ())
+        ex = jit.lower(*[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in args]).compile()
+        from .. import obs
+
+        obs.histogram("slate_serve_compile_seconds",
+                      "AOT compile time per cache miss").observe(
+                          time.perf_counter() - t0, **labels)
+        with self._lock:
+            # a racing compile of the same key: last one wins, both usable
+            self._table[key] = ex
+            self._table.move_to_end(key)
+            while len(self._table) > self.capacity:
+                self._table.popitem(last=False)
+                self.evictions += 1
+                _counter("slate_serve_cache_evictions_total",
+                         "executable-cache LRU evictions").inc()
+            from .. import obs as _obs
+
+            _obs.gauge("slate_serve_cache_size",
+                       "live executables in the cache").set(len(self._table))
+        return ex
+
+    def warmup(self, routine: str, build: Callable,
+               shapes: Sequence[Tuple[Tuple[int, ...], Any]],
+               opts: Optional[Options] = None, donate: bool = False) -> None:
+        """Pre-compile one executable without running it.
+
+        ``shapes`` is a sequence of ``(shape, dtype)`` pairs, one per
+        argument of ``build`` — the warm-up API the queue calls for every
+        (routine, shape bucket, batch bucket) combo it may pack, so the
+        serving path hits 100% after warm-up by construction."""
+        import jax
+
+        args = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in shapes]
+        self.get(routine, build, args, opts, donate=donate)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "size": len(self._table)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._table.clear()
+            self.hits = self.misses = self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+
+#: the process-wide cache the batched drivers and the default queue share
+_DEFAULT: Optional[ExecutableCache] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_cache() -> ExecutableCache:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = ExecutableCache()
+        return _DEFAULT
+
+
+def reset_cache() -> None:
+    """Drop the process-wide cache (test isolation; frees executables)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is not None:
+            _DEFAULT.clear()
+        _DEFAULT = None

@@ -1,0 +1,440 @@
+"""Shape-bucketing + padding policy and the mixed-traffic serving queue.
+
+The throughput problem (ROADMAP item 2): a million users submit *small*
+heterogeneous solves — n=13 here, n=57 there, gesv next to gels — and XLA
+wants large, shape-static batches.  The bridge is the classic serving recipe
+(BLASX's scheduler over a software cache, PAPERS.md):
+
+* **Bucket** every request's dims up to a small set of rounded shapes, so
+  mixed traffic collapses onto a handful of compiled programs.
+* **Pad** each operand into its bucket in a solution-preserving way:
+  square solves extend A with an identity block (the padded subsystem is
+  ``I z = 0`` — well-posed, SPD-preserving), least squares extends A with
+  ``[[A, 0], [0, I]]`` so the padded normal equations stay block-diagonal
+  and the true solution rides in the leading block.
+* **Pack** requests of one (routine, bucket, dtype) into batches — flushed
+  at ``max_batch`` or after ``max_wait_ms``, whichever first — and round
+  the batch axis up to a pow-2 bucket (repeating the last element) so batch
+  sizes, too, come from a bounded set and the executable cache stays small.
+
+Latency vs occupancy is the policy's one real tradeoff: larger
+``max_batch``/``max_wait_ms`` raise solves/sec (better MXU occupancy,
+fewer executable calls) and raise p99 (requests wait for the pack); the
+knobs are per-queue so latency-sensitive traffic can run a smaller pack.
+Every batch records its occupancy (real/padded) and every request its
+queue-to-result latency in the obs registry (``slate_serve_*``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.exceptions import SlateError, slate_assert
+from ..core.types import Options
+from . import batched as _batched
+from .cache import ExecutableCache, default_cache
+
+#: queue-able routines -> batched driver
+DRIVERS = {
+    "gesv": _batched.gesv_batched,
+    "posv": _batched.posv_batched,
+    "gels": _batched.gels_batched,
+}
+
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def _obs():
+    from .. import obs
+
+    return obs
+
+
+def _pow2_at_least(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPolicy:
+    """Shape/batch rounding + flush knobs for one queue.
+
+    dims:        matrix-dimension buckets (rounded up; beyond the last entry
+                 rounding falls back to the next power of two).
+    nrhs_dims:   right-hand-side count buckets.
+    batch_dims:  batch-axis buckets (pow-2 by default); the largest is the
+                 effective max batch.
+    max_batch:   flush a bucket as soon as this many requests are pending.
+    max_wait_ms: flush a non-empty bucket this long after its oldest request
+                 arrived, even if underfull (the latency bound).
+    """
+
+    dims: Tuple[int, ...] = (16, 32, 64, 96, 128)
+    nrhs_dims: Tuple[int, ...] = (1, 4, 8)
+    # a sparse batch ladder: each extra rung is one more compiled executable
+    # per (routine, shape bucket) — 4 rungs keeps worst-case slot waste at
+    # 4x on tiny flushes while bounding warm-up compile count
+    batch_dims: Tuple[int, ...] = (1, 4, 16, 32)
+    max_batch: int = 32
+    max_wait_ms: float = 5.0
+
+    def round_dim(self, n: int, dims: Optional[Sequence[int]] = None) -> int:
+        dims = self.dims if dims is None else dims
+        for d in dims:
+            if n <= d:
+                return int(d)
+        return _pow2_at_least(n)
+
+    def round_batch(self, b: int) -> int:
+        return self.round_dim(min(b, self.max_batch), self.batch_dims)
+
+    def bucket(self, routine: str, m: int, n: int, nrhs: int
+               ) -> Tuple[int, int, int]:
+        """(m', n', nrhs') padded dims for one request."""
+        bn = self.round_dim(n)
+        br = self.round_dim(nrhs, self.nrhs_dims)
+        if routine in ("gesv", "posv"):
+            slate_assert(m == n, f"{routine}: square systems only "
+                                 f"(got {m}x{n})")
+            return bn, bn, br
+        bm = self.round_dim(m)
+        # least squares: the identity block that carries the padded columns
+        # (tall) or padded rows (wide) must fit — bump the larger side's
+        # bucket until it does, preserving the request's shape class
+        if m >= n:
+            while bm - m < bn - n:
+                bm = self.round_dim(bm + 1)
+        else:
+            while bn - n < bm - m:
+                bn = self.round_dim(bn + 1)
+        return bm, bn, br
+
+
+def pad_request(routine: str, a, b, bucket: Tuple[int, int, int]):
+    """Embed one request into its bucket shape, solution-preserving.
+
+    Square solves: ``A' = [[A, 0], [0, I]]``, ``b' = [b; 0]`` — the padded
+    block solves ``I z = 0`` (SPD-preserving for posv).  Least squares: the
+    same block embedding, with the identity carried on the padded rows x
+    padded cols corner so the padded normal equations are block-diagonal
+    (tall) / the padded minimum-norm system fixes z = 0 (wide)."""
+    bm, bn, br = bucket
+    m, n = a.shape[-2:]
+    nrhs = b.shape[-1]
+    pm, pn = bm - m, bn - n
+    # host-side numpy: the per-request pad must not cost an eager device
+    # dispatch per operand (the packer touches thousands of requests/sec)
+    ap = np.zeros((bm, bn), dtype=np.asarray(a).dtype)
+    ap[:m, :n] = np.asarray(a)
+    k = min(pm, pn)
+    if k:
+        # the identity block at (m, n); leftover padded rows (tall LS) or
+        # cols (wide LS) stay zero — the Gram/QR stays nonsingular because
+        # the identity covers the smaller padding side exactly
+        ap[m + np.arange(k), n + np.arange(k)] = 1
+    bp = np.zeros((bm, br), dtype=np.asarray(b).dtype)
+    bp[:m, :nrhs] = np.asarray(b)
+    return ap, bp
+
+
+def unpad_result(x, n: int, nrhs: int):
+    return x[..., :n, :nrhs]
+
+
+class Ticket:
+    """Async handle for one submitted request."""
+
+    __slots__ = ("routine", "shape", "_event", "_value", "_error",
+                 "t_submit", "latency_s")
+
+    def __init__(self, routine: str, shape):
+        self.routine = routine
+        self.shape = shape
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.latency_s: Optional[float] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until solved; returns ``(x, info)`` (x unpadded)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"{self.routine} request not served within "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _resolve(self, value=None, error: Optional[BaseException] = None):
+        self.latency_s = time.perf_counter() - self.t_submit
+        self._value, self._error = value, error
+        self._event.set()
+
+
+class _Pending:
+    __slots__ = ("ticket", "a", "b", "n", "nrhs")
+
+    def __init__(self, ticket, a, b, n, nrhs):
+        self.ticket, self.a, self.b = ticket, a, b
+        self.n, self.nrhs = n, nrhs
+
+
+def _normalize_request(policy: BucketPolicy, routine: str, a, b
+                       ) -> Tuple[tuple, _Pending]:
+    """One request -> its group key + pending record.  The single
+    normalization path both verbs share (async ``submit`` and sync
+    ``solve_many``): host-side asarray (operands stay off-device until the
+    packer's stacked transfer), 1-D rhs promotion, bucket lookup, and the
+    ``slate_serve_requests_total`` sample."""
+    if routine not in DRIVERS:
+        raise SlateError(f"serve: unknown routine {routine!r}; "
+                         f"expected one of {sorted(DRIVERS)}")
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if b.ndim == 1:
+        b = b[:, None]
+    m, n = a.shape[-2:]
+    bucket = policy.bucket(routine, m, n, b.shape[-1])
+    _obs().counter("slate_serve_requests_total", "submitted requests").inc(
+        routine=routine, bucket="x".join(str(d) for d in bucket))
+    item = _Pending(Ticket(routine, (m, n, b.shape[-1])), a, b,
+                    n, b.shape[-1])
+    return (routine, bucket, str(a.dtype)), item
+
+
+def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
+                      items: Sequence[_Pending], opts: Options,
+                      cache: ExecutableCache, policy: BucketPolicy) -> None:
+    """Pad + pack one bucket's requests, run the batched driver, distribute."""
+    obs = _obs()
+    t0 = time.perf_counter()
+    nb = policy.round_batch(len(items))
+    try:
+        padded = [pad_request(routine, it.a, it.b, bucket) for it in items]
+        while len(padded) < nb:
+            padded.append(padded[-1])       # repeat-pad the batch axis
+        # one host->device transfer per packed operand, not one per request
+        A = jnp.asarray(np.stack([p[0] for p in padded]))
+        B = jnp.asarray(np.stack([p[1] for p in padded]))
+        out = DRIVERS[routine](A, B, opts, cache=cache)
+        x, info = out[0], out[-1]
+        x.block_until_ready()
+        xs = np.asarray(x)
+        infos = np.asarray(info)
+        for i, it in enumerate(items):
+            it.ticket._resolve((unpad_result(xs[i], it.n, it.nrhs),
+                                int(infos[i])))
+    except BaseException as e:  # noqa: BLE001 - surfaced on every ticket
+        for it in items:
+            if not it.ticket.done():
+                it.ticket._resolve(error=e)
+        return
+    finally:
+        labels = {"routine": routine,
+                  "bucket": "x".join(str(d) for d in bucket)}
+        obs.counter("slate_serve_batches_total",
+                    "executed batches").inc(**labels)
+        obs.histogram("slate_serve_batch_occupancy",
+                      "real requests / padded batch slots",
+                      buckets=_OCCUPANCY_BUCKETS).observe(
+                          len(items) / max(nb, 1), **labels)
+        obs.histogram("slate_serve_batch_seconds",
+                      "wall time per executed batch").observe(
+                          time.perf_counter() - t0, **labels)
+    for it in items:
+        obs.histogram("slate_serve_latency_seconds",
+                      "submit-to-result latency per request").observe(
+                          it.ticket.latency_s, routine=routine)
+
+
+class ServeQueue:
+    """Mixed-traffic serving queue over the batched drivers.
+
+    ::
+
+        q = serve.ServeQueue()
+        t = q.submit("gesv", a, b)        # a (n, n), b (n,) or (n, nrhs)
+        x, info = t.result()
+
+    A background worker packs pending requests per (routine, bucket, dtype)
+    and flushes on ``max_batch`` / ``max_wait_ms`` (see
+    :class:`BucketPolicy`).  ``close()`` drains and stops the worker; the
+    queue is also a context manager.
+    """
+
+    def __init__(self, policy: Optional[BucketPolicy] = None,
+                 opts: Optional[Options] = None,
+                 cache: Optional[ExecutableCache] = None,
+                 start: bool = True):
+        self.policy = policy or BucketPolicy()
+        self.opts = Options.make(opts)
+        self.cache = default_cache() if cache is None else cache
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Dict[tuple, List[_Pending]] = {}
+        self._oldest: Dict[tuple, float] = {}
+        self._inflight = 0           # popped off _pending, not yet served
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        if start:
+            self._worker = threading.Thread(target=self._loop, daemon=True,
+                                            name="slate-serve-queue")
+            self._worker.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, routine: str, a, b) -> Ticket:
+        key, item = _normalize_request(self.policy, routine, a, b)
+        with self._cv:
+            if self._closed:
+                raise SlateError("serve: queue is closed")
+            self._pending.setdefault(key, []).append(item)
+            self._oldest.setdefault(key, time.perf_counter())
+            self._cv.notify()
+        return item.ticket
+
+    def warmup(self, combos: Sequence[Tuple[str, int, int, int]],
+               dtype=jnp.float32) -> int:
+        """Pre-compile every executable the given traffic can need.
+
+        ``combos`` is ``(routine, m, n, nrhs)`` request shapes; each maps to
+        its bucket and compiles at *every* batch bucket, so subsequent mixed
+        traffic takes zero cache misses regardless of how flushes split.
+        Returns the number of executables now warm."""
+        # dedupe first: many request shapes share a bucket, and each
+        # (routine, bucket, batch-rung) is one compile
+        buckets = sorted({(routine, self.policy.bucket(routine, m, n, nrhs))
+                          for routine, m, n, nrhs in combos})
+        seen = 0
+        for routine, (bm, bn, br) in buckets:
+            for nb in self.policy.batch_dims:
+                if nb > self.policy.max_batch:
+                    continue
+                # the drivers' own builder: a local copy could drift and the
+                # cache key would not notice (it excludes function identity)
+                self.cache.warmup(
+                    routine + "_batched",
+                    _batched.batched_build(routine + "_batched"),
+                    [((nb, bm, bn), dtype), ((nb, bm, br), dtype)],
+                    self.opts)
+                seen += 1
+        return seen
+
+    # -- worker --------------------------------------------------------------
+    def _ready_keys(self, now: float) -> List[tuple]:
+        ready = []
+        for key, items in self._pending.items():
+            if not items:
+                continue
+            age_ms = (now - self._oldest[key]) * 1e3
+            if len(items) >= self.policy.max_batch \
+                    or age_ms >= self.policy.max_wait_ms:
+                ready.append(key)
+        return ready
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    now = time.perf_counter()
+                    ready = self._ready_keys(now)
+                    if ready or self._closed:
+                        break
+                    # sleep until the oldest pending bucket hits max_wait
+                    if self._pending and any(self._pending.values()):
+                        oldest = min(self._oldest[k]
+                                     for k, v in self._pending.items() if v)
+                        wait = max(self.policy.max_wait_ms / 1e3
+                                   - (now - oldest), 1e-4)
+                        self._cv.wait(timeout=wait)
+                    else:
+                        self._cv.wait()
+                if self._closed and not any(self._pending.values()):
+                    return
+                work = []
+                for key in (ready or list(self._pending)):
+                    items = self._pending.pop(key, [])
+                    self._oldest.pop(key, None)
+                    if items:
+                        work.append((key, items))
+                # popped-but-unserved requests are invisible in _pending;
+                # _inflight keeps flush() honest about them
+                self._inflight += sum(len(i) for _, i in work)
+            try:
+                for (routine, bucket, _), items in work:
+                    for chunk0 in range(0, len(items), self.policy.max_batch):
+                        _run_bucket_batch(
+                            routine, bucket,
+                            items[chunk0:chunk0 + self.policy.max_batch],
+                            self.opts, self.cache, self.policy)
+            finally:
+                with self._cv:
+                    self._inflight -= sum(len(i) for _, i in work)
+                    self._cv.notify_all()
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until everything pending at call time has been SERVED —
+        not merely popped off the queue (tickets resolved, metrics
+        recorded)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            self._cv.notify_all()      # wake the worker for age-based flushes
+            while any(self._pending.values()) or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("serve: flush timed out")
+                self._cv.wait(timeout=min(left, 0.05))
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+
+    def __enter__(self) -> "ServeQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def solve_many(requests: Sequence[Tuple[str, Any, Any]],
+               opts: Optional[Options] = None,
+               policy: Optional[BucketPolicy] = None,
+               cache: Optional[ExecutableCache] = None
+               ) -> List[Tuple[np.ndarray, int]]:
+    """Synchronous mixed-traffic verb: bucket, pack, and solve ``requests``
+    (``(routine, a, b)`` triples) in one pass, returning ``(x, info)`` per
+    request *in submission order*.  The deterministic sibling of
+    :class:`ServeQueue` — same bucketing/padding/batching policy, no worker
+    thread, used by the bench workload and the CI smoke."""
+    policy = policy or BucketPolicy()
+    opts = Options.make(opts)
+    cache = default_cache() if cache is None else cache
+    groups: Dict[tuple, List[Tuple[int, _Pending]]] = {}
+    results: List[Optional[Tuple[np.ndarray, int]]] = [None] * len(requests)
+    for i, (routine, a, b) in enumerate(requests):
+        key, item = _normalize_request(policy, routine, a, b)
+        groups.setdefault(key, []).append((i, item))
+    for (routine, bucket, _), pairs in groups.items():
+        for c0 in range(0, len(pairs), policy.max_batch):
+            chunk = pairs[c0:c0 + policy.max_batch]
+            _run_bucket_batch(routine, bucket, [it for _, it in chunk],
+                              opts, cache, policy)
+            for i, it in chunk:
+                results[i] = it.ticket.result(timeout=0)
+    return results  # type: ignore[return-value]

@@ -1,0 +1,142 @@
+"""Synthetic mixed-workload generator + the serving-throughput measurement.
+
+The bench axis ROADMAP item 2 asks for: not GFLOP/s on one n=16384 problem,
+but solves/sec and p50/p99 latency under thousands of small heterogeneous
+requests — the shape of real serving traffic.  ``make_requests`` draws a
+seeded stream of small gesv/posv/gels problems across ≥4 shape buckets;
+``run_mixed_workload`` pushes them through the serving queue (warm-up pass
+first, so the measured pass exercises the steady state: zero compiles, warm
+executable cache) and reports throughput + latency percentiles + cache and
+occupancy statistics.  Used by ``bench.py --child serve_mixed`` and the CI
+``serving-smoke`` step (tools/serving_smoke.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.types import Options
+from .cache import ExecutableCache
+from .queue import BucketPolicy, ServeQueue, solve_many
+
+#: default mixed-traffic dimension pool — spans 4+ policy buckets
+#: (<=16, <=32, <=64, <=96) with off-bucket sizes so padding really runs
+DEFAULT_DIMS = (8, 13, 24, 30, 48, 60, 80)
+DEFAULT_ROUTINES = ("gesv", "posv", "gels")
+
+
+def make_requests(num: int = 1000, seed: int = 0,
+                  dims: Sequence[int] = DEFAULT_DIMS,
+                  routines: Sequence[str] = DEFAULT_ROUTINES,
+                  nrhs_pool: Sequence[int] = (1, 4),
+                  dtype=np.float32) -> List[Tuple[str, Any, Any]]:
+    """A seeded stream of well-posed small solve requests.
+
+    gesv: diagonally-dominant square systems; posv: SPD (Gram + shift);
+    gels: tall (2n x n) least squares.  Returns ``(routine, a, b)`` triples
+    in arrival order."""
+    rng = np.random.default_rng(seed)
+    reqs: List[Tuple[str, Any, Any]] = []
+    for _ in range(num):
+        routine = routines[rng.integers(len(routines))]
+        n = int(dims[rng.integers(len(dims))])
+        nrhs = int(nrhs_pool[rng.integers(len(nrhs_pool))])
+        if routine == "gels":
+            m = 2 * n
+            a = rng.standard_normal((m, n)).astype(dtype)
+        else:
+            m = n
+            a = rng.standard_normal((n, n)).astype(dtype)
+            if routine == "posv":
+                a = (a @ a.T + n * np.eye(n)).astype(dtype)
+            else:
+                a = a + n * np.eye(n, dtype=dtype)
+        b = rng.standard_normal((m, nrhs)).astype(dtype)
+        reqs.append((routine, a, b))
+    return reqs
+
+
+def _percentile_ms(lat_s: Sequence[float], q: float) -> float:
+    return float(np.percentile(np.asarray(lat_s), q) * 1e3)
+
+
+def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
+                       policy: Optional[BucketPolicy] = None,
+                       opts: Optional[Options] = None,
+                       dims: Sequence[int] = DEFAULT_DIMS,
+                       routines: Sequence[str] = DEFAULT_ROUTINES,
+                       use_queue: bool = True,
+                       warm: bool = True,
+                       check: bool = True) -> Dict[str, Any]:
+    """Generate, warm up, and serve a mixed workload; return the stats dict.
+
+    Two passes over the same request stream: the warm-up pass compiles every
+    (routine, shape bucket, batch bucket) executable (via the queue's
+    ``warmup`` sweep — deterministic, flush-split-independent), then the
+    measured pass times steady-state serving.  ``use_queue=True`` routes
+    through the async :class:`ServeQueue` (latency includes queue wait);
+    False uses the synchronous :func:`solve_many` packer.  ``check=True``
+    verifies every request's info == 0 and result finite."""
+    policy = policy or BucketPolicy()
+    opts = Options.make(opts)
+    cache = ExecutableCache()
+    reqs = make_requests(num_requests, seed, dims=dims, routines=routines)
+    combos = sorted({(r, a.shape[0], a.shape[1], b.shape[1])
+                     for r, a, b in reqs})
+
+    q = ServeQueue(policy=policy, opts=opts, cache=cache, start=use_queue)
+    warm_stats = None
+    if warm:
+        t0 = time.perf_counter()
+        q.warmup(combos, dtype=reqs[0][1].dtype)
+        warm_stats = {"seconds": round(time.perf_counter() - t0, 3),
+                      **cache.stats()}
+    miss0, hit0 = cache.misses, cache.hits
+
+    t0 = time.perf_counter()
+    latencies: List[float] = []
+    if use_queue:
+        tickets = [q.submit(r, a, b) for r, a, b in reqs]
+        results = [t.result(timeout=300.0) for t in tickets]
+        latencies = [t.latency_s for t in tickets]
+    else:
+        items = solve_many(reqs, opts=opts, policy=policy, cache=cache)
+        results = list(items)
+    wall = time.perf_counter() - t0
+    q.close()
+
+    bad = 0
+    for x, info in results:
+        if int(info) != 0 or not np.all(np.isfinite(np.asarray(x))):
+            bad += 1
+    if check and bad:
+        raise AssertionError(f"serve workload: {bad}/{len(results)} requests "
+                             "returned nonzero info or non-finite results")
+
+    buckets = sorted({"x".join(map(str, policy.bucket(r, a.shape[0],
+                                                      a.shape[1], b.shape[1])))
+                      for r, a, b in reqs})
+    stats: Dict[str, Any] = {
+        "requests": len(reqs),
+        "wall_s": round(wall, 4),
+        "solves_per_sec": round(len(reqs) / wall, 1),
+        "distinct_buckets": len(buckets),
+        "buckets": buckets,
+        "routines": sorted(set(r for r, _, _ in reqs)),
+        "bad": bad,
+        "cache": cache.stats(),
+        "misses_after_warmup": cache.misses - miss0,
+        "hits_measured": cache.hits - hit0,
+        "warmup": warm_stats,
+    }
+    if latencies:
+        stats["p50_ms"] = round(_percentile_ms(latencies, 50), 3)
+        stats["p99_ms"] = round(_percentile_ms(latencies, 99), 3)
+    else:
+        # solve_many path: per-request latency is the packed batch's wall
+        # time, recorded on each ticket by the runner — not collected here
+        stats["p50_ms"] = stats["p99_ms"] = None
+    return stats

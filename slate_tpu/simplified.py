@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from . import blas as _blas
 from . import linalg as _la
+from . import serve as _serve
 
 __all__ = [
     # BLAS-3
@@ -35,6 +36,9 @@ __all__ = [
     "eig", "eig_vals", "svd", "svd_vals",
     # misc
     "triangular_inverse", "triangular_condest",
+    # batched serving tier (slate_tpu.serve)
+    "batched_lu_solve", "batched_chol_solve", "batched_least_squares_solve",
+    "submit", "solve_many",
 ]
 
 # --- BLAS-3 (simplified_api.hh Level 3 section) ---
@@ -101,3 +105,11 @@ svd_vals = _la.svd_vals
 # --- misc ---
 triangular_inverse = _la.trtri
 triangular_condest = _la.trcondest
+
+# --- batched serving tier (slate_tpu.serve; no reference analogue — the
+# verb names extend the simplified_api.hh vocabulary to the batch axis) ---
+batched_lu_solve = _serve.gesv_batched
+batched_chol_solve = _serve.posv_batched
+batched_least_squares_solve = _serve.gels_batched
+submit = _serve.submit                      # async single request
+solve_many = _serve.solve_many              # sync mixed-traffic packer

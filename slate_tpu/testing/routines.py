@@ -550,6 +550,95 @@ def run_gels(p, slate):
 
 
 # ---------------------------------------------------------------------------
+# batched serving tier (slate_tpu.serve; the reference's batch-BLAS L1 has no
+# tester rows — these sweep the vmap-first drivers the serving queue packs)
+
+def _batch_stack(gen_one, bs):
+    return np.stack([gen_one(i) for i in range(bs)])
+
+
+def _batched_result(p, errs, flops, t, tol_mult=1.0):
+    out = _result(p, max(errs), flops, t, tol_mult=tol_mult)
+    out.setdefault("details", {})["batch"] = len(errs)
+    return out
+
+
+@_routine("gesv_batched", "serve")
+def run_gesv_batched(p, slate):
+    """Batched gesv (serve.gesv_batched): max over the batch of per-element
+    residuals; per-element info must be all-zero."""
+    n, nrhs = p["n"], min(p.get("nrhs", 4), 4)
+    bs = int(p.get("batch", 4))
+    A = _batch_stack(lambda i: _gen("randn", n, n, dict(p, seed=p["seed"] + i))
+                     + n * np.eye(n, dtype=p["dtype"]), bs)
+    b = _batch_stack(lambda i: _gen("randn", n, nrhs,
+                                    dict(p, seed=100 + p["seed"] + i)), bs)
+    from slate_tpu import serve
+
+    (X, perm, info), t = time_call(
+        lambda: serve.gesv_batched(jnp.asarray(A), jnp.asarray(b)),
+        repeat=p["repeat"])
+    assert not np.asarray(info).any(), f"nonzero batched info {info}"
+    x = np.asarray(X)
+    errs = [_rel(np.linalg.norm(A[i] @ x[i] - b[i]),
+                 np.linalg.norm(A[i]) * np.linalg.norm(x[i]))
+            for i in range(bs)]
+    return _batched_result(p, errs, bs * (2 * n**3 / 3 + 2.0 * n * n * nrhs), t)
+
+
+@_routine("posv_batched", "serve")
+def run_posv_batched(p, slate):
+    """Batched SPD solve (serve.posv_batched) over a stack of full Hermitian
+    operands."""
+    n, nrhs = p["n"], min(p.get("nrhs", 4), 4)
+    bs = int(p.get("batch", 4))
+    A = _batch_stack(lambda i: _spd(n, dict(p, seed=p["seed"] + i)), bs)
+    b = _batch_stack(lambda i: _gen("randn", n, nrhs,
+                                    dict(p, seed=100 + p["seed"] + i)), bs)
+    from slate_tpu import serve
+
+    (X, info), t = time_call(
+        lambda: serve.posv_batched(jnp.asarray(A), jnp.asarray(b)),
+        repeat=p["repeat"])
+    assert not np.asarray(info).any(), f"nonzero batched info {info}"
+    x = np.asarray(X)
+    errs = [_rel(np.linalg.norm(A[i] @ x[i] - b[i]),
+                 np.linalg.norm(A[i]) * np.linalg.norm(x[i]))
+            for i in range(bs)]
+    return _batched_result(p, errs, bs * (n**3 / 3 + 2.0 * n * n * nrhs), t)
+
+
+@_routine("gels_batched", "serve")
+def run_gels_batched(p, slate):
+    """Batched least squares (serve.gels_batched): normal-equations residual
+    per element, sweeping the tall/square/wide shape grid via --tall/--wide."""
+    m, n, nrhs = p["m"], p["n"], min(p.get("nrhs", 4), 4)
+    bs = int(p.get("batch", 4))
+    A = _batch_stack(lambda i: _gen("randn", m, n,
+                                    dict(p, seed=p["seed"] + i)), bs)
+    b = _batch_stack(lambda i: _gen("randn", m, nrhs,
+                                    dict(p, seed=100 + p["seed"] + i)), bs)
+    from slate_tpu import serve
+
+    (X, info), t = time_call(
+        lambda: serve.gels_batched(jnp.asarray(A), jnp.asarray(b)),
+        repeat=p["repeat"])
+    assert not np.asarray(info).any(), f"nonzero batched info {info}"
+    x = np.asarray(X)
+    errs = []
+    for i in range(bs):
+        if m >= n:
+            r = A[i].conj().T @ (A[i] @ x[i] - b[i])
+            errs.append(_rel(np.linalg.norm(r), np.linalg.norm(A[i]) ** 2
+                             * max(np.linalg.norm(x[i]), 1e-10)))
+        else:       # consistent underdetermined system: direct residual
+            errs.append(_rel(np.linalg.norm(A[i] @ x[i] - b[i]),
+                             np.linalg.norm(A[i]) * np.linalg.norm(x[i])))
+    return _batched_result(p, errs, bs * 2.0 * m * n * min(m, n), t,
+                           tol_mult=100)
+
+
+# ---------------------------------------------------------------------------
 # eig / svd
 
 @_routine("heev", "eig")

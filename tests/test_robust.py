@@ -313,6 +313,105 @@ class TestCholeskyChaos:
 # distributed fault classes (virtual 8-device mesh, conftest)
 # ---------------------------------------------------------------------------
 
+class TestBatchedFaultIsolation:
+    """Batched serving drivers (slate_tpu.serve): one poisoned element of a
+    batch must (1) report ITS index only, (2) leave siblings bit-identical
+    to a clean batch, and (3) re-run only itself under the declared
+    batched→elementwise ladder (robust.LADDERS["gesv_batched"])."""
+
+    def _batch(self, rng, B=4, n=16, dtype=np.float32):
+        a = np.stack([rng.standard_normal((n, n)).astype(dtype)
+                      + n * np.eye(n, dtype=dtype) for _ in range(B)])
+        b = np.stack([rng.standard_normal((n, 2)).astype(dtype)
+                      for _ in range(B)])
+        return jnp.asarray(a), jnp.asarray(b)
+
+    def test_batched_first_bad_index(self):
+        bad = jnp.array([[False, False], [True, False], [False, True]])
+        got = [int(v) for v in robust.first_bad_index_batched(bad)]
+        assert got == [0, 1, 2]
+
+    def test_zero_pivot_isolated_info_and_siblings(self, rng):
+        from slate_tpu import serve
+
+        a, b = self._batch(rng)
+        x_clean, _, info_clean = serve.gesv_batched(a, b)
+        assert not np.asarray(info_clean).any()
+        plan = FaultPlan([FaultSpec("gesv_batched", "zero_pivot",
+                                    call_index=2, index=5)])
+        with plan:
+            x, perm, info = serve.gesv_batched(
+                a, b, opts={"use_fallback_solver": False})
+        info = np.asarray(info)
+        # (1) the poisoned element reports its own pivot index, 1-based
+        assert info[2] == 6, info
+        assert plan.fired == (("gesv_batched", "zero_pivot", 2),)
+        # siblings report 0 and are BIT-identical to the clean batch
+        for i in (0, 1, 3):
+            assert info[i] == 0
+            assert np.array_equal(np.asarray(x[i]), np.asarray(x_clean[i]))
+
+    def test_element_granular_ladder_rerun(self, rng):
+        """Default opts: the failed element re-runs alone from the pristine
+        operand (the injected fault is transient by call-index accounting),
+        recovers, and its report carries the batched→elementwise chain;
+        siblings never re-run (their chain stays ("batched",))."""
+        from slate_tpu import serve
+
+        a, b = self._batch(rng)
+        x_clean, _, _ = serve.gesv_batched(a, b)
+        plan = FaultPlan([FaultSpec("gesv_batched", "zero_pivot",
+                                    call_index=1, index=3)])
+        with plan:
+            x, perm, info, reports = serve.gesv_batched(
+                a, b, opts={"solve_report": True})
+        assert not np.asarray(info).any()          # recovered end-to-end
+        assert reports[1].fallback_chain == ("batched", "elementwise")
+        assert reports[1].recovered and reports[1].info == 0
+        assert reports[1].faults == (("gesv_batched", "zero_pivot", 1),)
+        for i in (0, 2, 3):
+            assert reports[i].fallback_chain == ("batched",)
+            assert np.array_equal(np.asarray(x[i]), np.asarray(x_clean[i]))
+        # the recovered element really solves its system
+        r = np.asarray(a[1]) @ np.asarray(x[1]) - np.asarray(b[1])
+        assert np.linalg.norm(r) < 1e-3
+
+    def test_posv_batched_nan_tile_isolated(self, rng):
+        from slate_tpu import serve
+
+        B, n = 3, 16
+        g = rng.standard_normal((B, n, n)).astype(np.float32)
+        a = jnp.asarray(g @ np.swapaxes(g, -1, -2)
+                        + n * np.eye(n, dtype=np.float32))
+        b = jnp.asarray(rng.standard_normal((B, n, 2)).astype(np.float32))
+        with FaultPlan([FaultSpec("posv_batched", "nan_tile",
+                                  call_index=0, tile=(0, 0), nb=8)]):
+            x, info, reports = serve.posv_batched(
+                a, b, opts={"solve_report": True})
+        assert not np.asarray(info).any()
+        assert reports[0].fallback_chain == ("batched", "elementwise")
+        assert reports[1].fallback_chain == ("batched",)
+
+    def test_unrecoverable_element_reports_honestly(self, rng):
+        """A literally singular element (not an injected transient): the
+        elementwise re-run also fails, recovered=False on that report only,
+        and the final info keeps the element's code."""
+        from slate_tpu import serve
+
+        a, b = self._batch(rng)
+        a = np.array(a)                 # writable host copy
+        a[2][:, 4] = 0.0
+        a[2][4, :] = 0.0
+        x, perm, info, reports = serve.gesv_batched(
+            jnp.asarray(a), b, opts={"solve_report": True})
+        info = np.asarray(info)
+        assert info[2] != 0
+        assert not reports[2].recovered
+        assert reports[2].fallback_chain == ("batched", "elementwise")
+        for i in (0, 1, 3):
+            assert info[i] == 0 and reports[i].recovered
+
+
 class TestDistributedChaos:
     @pytest.fixture
     def grid(self):

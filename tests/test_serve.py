@@ -1,0 +1,422 @@
+"""Batched solver service (slate_tpu.serve): vmap parity, Options cache
+keys, the compiled-executable cache (compile-count pin), the bucketing/
+padding policy, the mixed-traffic queue, and the batch-sharded parallel
+entry.  The chaos-side fault-isolation contract is covered in
+tests/test_robust.py (TestBatchedFaultIsolation)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import slate_tpu as slate
+from slate_tpu import serve
+from slate_tpu.core.types import Options
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.queue import BucketPolicy, pad_request, unpad_result
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _dd(n, dtype, seed=0):
+    """Diagonally-dominant square system."""
+    a = _rng(seed).standard_normal((n, n)).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        a = a + 1j * _rng(seed + 1).standard_normal((n, n)).astype(a.dtype)
+    return a + n * np.eye(n, dtype=dtype)
+
+
+def _spd(n, dtype, seed=0):
+    g = _rng(seed).standard_normal((n, n)).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        g = g + 1j * _rng(seed + 1).standard_normal((n, n)).astype(g.dtype)
+    return (g @ g.conj().T + n * np.eye(n)).astype(dtype)
+
+
+def _randn(m, n, dtype, seed=0):
+    b = _rng(seed).standard_normal((m, n)).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        b = b + 1j * _rng(seed + 7).standard_normal((m, n)).astype(b.dtype)
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Options.cache_key (satellite: hashable/canonical Options)
+
+
+class TestOptionsCacheKey:
+    def test_hashable_and_stable(self):
+        k = Options().cache_key()
+        assert isinstance(k, tuple)
+        assert hash(k) == hash(Options().cache_key())
+        assert {k: 1}[Options().cache_key()] == 1    # usable as a dict key
+
+    def test_default_vs_explicit_equivalence(self):
+        """Explicitly passing a field's default must key identically to
+        omitting it (the cache must not recompile for spelled-out
+        defaults)."""
+        assert Options().cache_key() == Options(block_size=256).cache_key()
+        assert Options().cache_key() == \
+            Options.make({"lookahead": 1}).cache_key()
+
+    def test_enum_spelling_equivalence(self):
+        a = Options.make({"target": "tiled"}).cache_key()
+        b = Options.make({"target": slate.Target.Tiled}).cache_key()
+        assert a == b
+
+    def test_dtype_canonicalization(self):
+        a = Options(precision=jnp.float32).cache_key()
+        b = Options(precision=np.dtype("float32")).cache_key()
+        c = Options(precision="float32").cache_key()
+        assert a == b == c
+        assert a != Options(precision=jnp.bfloat16).cache_key()
+
+    def test_distinct_options_distinct_keys(self):
+        assert Options().cache_key() != Options(block_size=128).cache_key()
+        assert Options().cache_key() != \
+            Options(solve_report=True).cache_key()
+
+
+# ---------------------------------------------------------------------------
+# vmap parity: batched drivers == per-matrix loop of the existing drivers
+
+
+DTYPES = [np.float32, np.float64, np.complex64]
+
+
+class TestVmapParity:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    @pytest.mark.parametrize("n", [8, 17])
+    def test_gesv_batched_matches_loop(self, dtype, n):
+        B, nrhs = 3, 2
+        a = np.stack([_dd(n, dtype, seed=i) for i in range(B)])
+        b = np.stack([_randn(n, nrhs, dtype, seed=10 + i) for i in range(B)])
+        x, perm, info = serve.gesv_batched(jnp.asarray(a), jnp.asarray(b))
+        assert np.asarray(info).shape == (B,)
+        assert not np.asarray(info).any()
+        eps = np.finfo(np.dtype(dtype).char.lower()
+                       if np.dtype(dtype).kind == "c" else dtype).eps
+        for i in range(B):
+            xi, pi, ii = slate.gesv(a[i].copy(), b[i].copy())
+            np.testing.assert_allclose(np.asarray(x[i]), np.asarray(xi),
+                                       rtol=200 * eps, atol=200 * eps)
+            assert int(ii) == int(np.asarray(info)[i]) == 0
+
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_posv_batched_matches_loop(self, dtype):
+        B, n, nrhs = 3, 12, 2
+        a = np.stack([_spd(n, dtype, seed=i) for i in range(B)])
+        b = np.stack([_randn(n, nrhs, dtype, seed=20 + i) for i in range(B)])
+        x, info = serve.posv_batched(jnp.asarray(a), jnp.asarray(b))
+        assert not np.asarray(info).any()
+        eps = np.finfo(np.dtype(dtype).char.lower()
+                       if np.dtype(dtype).kind == "c" else dtype).eps
+        for i in range(B):
+            xi, ii = slate.posv(a[i].copy(), b[i].copy())
+            np.testing.assert_allclose(np.asarray(x[i]), np.asarray(xi),
+                                       rtol=500 * eps, atol=500 * eps)
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("shape", [(24, 8), (12, 12), (8, 24)])
+    def test_gels_batched_shape_grid(self, dtype, shape):
+        """Tall/square/wide grid: batched least squares agrees with the
+        per-matrix gels driver's solution quality (residual parity, not
+        bitwise — the single-matrix driver may take a different internal
+        route)."""
+        m, n = shape
+        B, nrhs = 3, 2
+        a = np.stack([_randn(m, n, dtype, seed=i) for i in range(B)])
+        b = np.stack([_randn(m, nrhs, dtype, seed=30 + i) for i in range(B)])
+        x, info = serve.gels_batched(jnp.asarray(a), jnp.asarray(b))
+        assert x.shape == (B, n, nrhs)
+        assert not np.asarray(info).any()
+        for i in range(B):
+            xi = np.asarray(slate.gels(a[i].copy(), b[i].copy()))[:n]
+            # both minimize the same objective: residual norms must agree
+            r_b = np.linalg.norm(a[i] @ np.asarray(x[i]) - b[i])
+            r_s = np.linalg.norm(a[i] @ xi - b[i])
+            tol = 200 * np.finfo(dtype).eps * max(m, n)
+            assert r_b <= r_s * (1 + 1e-3) + tol * np.linalg.norm(b[i])
+
+    def test_single_rhs_vector_squeeze(self):
+        B, n = 2, 8
+        a = np.stack([_dd(n, np.float32, seed=i) for i in range(B)])
+        b = np.stack([_randn(n, 1, np.float32, seed=i)[:, 0]
+                      for i in range(B)])
+        x, perm, info = serve.gesv_batched(jnp.asarray(a), jnp.asarray(b))
+        assert x.shape == (B, n)
+
+    def test_batched_info_is_per_element(self):
+        """A singular element reports its own index; siblings report 0 —
+        without chaos machinery (a literally singular matrix)."""
+        B, n = 3, 8
+        a = np.stack([_dd(n, np.float32, seed=i) for i in range(B)])
+        a[1][:, 3] = 0.0
+        a[1][3, :] = 0.0
+        b = np.stack([_randn(n, 1, np.float32, seed=i) for i in range(B)])
+        x, perm, info = serve.gesv_batched(
+            jnp.asarray(a), jnp.asarray(b),
+            opts={"use_fallback_solver": False})
+        info = np.asarray(info)
+        assert info[0] == 0 and info[2] == 0
+        assert info[1] != 0
+
+
+# ---------------------------------------------------------------------------
+# executable cache: compile-count pin
+
+
+class TestExecutableCache:
+    def test_hit_miss_accounting(self):
+        c = ExecutableCache()
+        a = jnp.asarray(_dd(8, np.float32))[None]
+        b = jnp.asarray(_randn(8, 2, np.float32))[None]
+        serve.gesv_batched(a, b, cache=c)
+        assert c.stats()["misses"] == 1 and c.stats()["hits"] == 0
+        serve.gesv_batched(a, b, cache=c)
+        assert c.stats()["misses"] == 1 and c.stats()["hits"] == 1
+
+    def test_compile_count_pin_mixed_traffic(self):
+        """THE pin: one compile per (routine, bucket, batch, dtype, Options)
+        under repeated mixed submissions — a silent recompile shows up as a
+        second miss for the same key and fails here."""
+        c = ExecutableCache()
+        reqs = serve.make_requests(60, seed=5, dims=(8, 13, 24))
+        serve.solve_many(reqs, cache=c)
+        first = c.stats()["misses"]
+        assert first > 0
+        for _ in range(3):       # identical traffic, repeated
+            serve.solve_many(reqs, cache=c)
+        assert c.stats()["misses"] == first, \
+            f"recompiles under repeated mixed traffic: {c.stats()}"
+        assert c.stats()["hits"] >= 2 * first
+
+    def test_options_change_recompiles_dtype_shares(self):
+        c = ExecutableCache()
+        a = jnp.asarray(_dd(8, np.float32))[None]
+        b = jnp.asarray(_randn(8, 1, np.float32))[None]
+        serve.gesv_batched(a, b, cache=c)
+        # same shapes, different Options -> new executable
+        serve.gesv_batched(a, b, opts={"block_size": 128}, cache=c)
+        assert c.stats()["misses"] == 2
+        # spelled-out default Options -> same executable
+        serve.gesv_batched(a, b, opts={"block_size": 256}, cache=c)
+        assert c.stats()["misses"] == 2 and c.stats()["hits"] >= 1
+
+    def test_warmup_then_zero_misses(self):
+        c = ExecutableCache()
+        q = serve.ServeQueue(cache=c, start=False)
+        q.warmup([("gesv", 13, 13, 2)])
+        warm = c.stats()["misses"]
+        assert warm == len([d for d in q.policy.batch_dims
+                            if d <= q.policy.max_batch])
+        reqs = [("gesv", _dd(13, np.float32, seed=i),
+                 _randn(13, 2, np.float32, seed=i)) for i in range(9)]
+        serve.solve_many(reqs, cache=c, policy=q.policy)
+        assert c.stats()["misses"] == warm, c.stats()
+        q.close()
+
+    def test_lru_eviction(self):
+        c = ExecutableCache(capacity=2)
+        for n in (4, 8, 12):
+            a = jnp.asarray(_dd(n, np.float32))[None]
+            b = jnp.asarray(_randn(n, 1, np.float32))[None]
+            serve.gesv_batched(a, b, cache=c)
+        s = c.stats()
+        assert s["size"] == 2 and s["evictions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# bucketing + padding policy
+
+
+class TestBucketPolicy:
+    def test_round_up_and_pow2_fallback(self):
+        p = BucketPolicy()
+        assert p.round_dim(9) == 16
+        assert p.round_dim(16) == 16
+        assert p.round_dim(97) == 128
+        assert p.round_dim(300) == 512      # beyond the table: next pow2
+
+    def test_ls_identity_fits(self):
+        p = BucketPolicy()
+        bm, bn, br = p.bucket("gels", 20, 16, 1)
+        assert bm - 20 >= bn - 16           # tall keeps room for the I block
+        bm, bn, br = p.bucket("gels", 16, 20, 1)
+        assert bn - 20 >= bm - 16           # wide likewise
+
+    @pytest.mark.parametrize("routine,shape", [
+        ("gesv", (13, 13)), ("posv", (13, 13)),
+        ("gels", (26, 13)), ("gels", (13, 26))])
+    def test_padding_preserves_solution(self, routine, shape):
+        m, n = shape
+        p = BucketPolicy()
+        if routine == "posv":
+            a = _spd(n, np.float32, seed=3)
+        elif routine == "gesv":
+            a = _dd(n, np.float32, seed=3)
+        else:
+            a = _randn(m, n, np.float32, seed=3)
+        b = _randn(m, 2, np.float32, seed=4)
+        bucket = p.bucket(routine, m, n, 2)
+        ap, bp = pad_request(routine, a, b, bucket)
+        assert ap.shape == bucket[:2] and bp.shape == (bucket[0], bucket[2])
+        if routine == "gels":
+            xp, info = serve.gels_batched(jnp.asarray(ap)[None],
+                                          jnp.asarray(bp)[None])
+            xr = np.asarray(slate.gels(a.copy(), b.copy()))[:n]
+        elif routine == "posv":
+            xp, info = serve.posv_batched(jnp.asarray(ap)[None],
+                                          jnp.asarray(bp)[None])
+            xr = np.asarray(slate.posv(a.copy(), b.copy())[0])
+        else:
+            xp, _, info = serve.gesv_batched(jnp.asarray(ap)[None],
+                                             jnp.asarray(bp)[None])
+            xr = np.asarray(slate.gesv(a.copy(), b.copy())[0])
+        assert not np.asarray(info).any()
+        x = unpad_result(np.asarray(xp[0]), n, 2)
+        np.testing.assert_allclose(x, xr, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# the serving queue
+
+
+class TestServeQueue:
+    def test_mixed_traffic_end_to_end(self):
+        q = serve.ServeQueue()
+        rng = _rng(7)
+        cases = []
+        for i in range(25):
+            kind = ("gesv", "posv", "gels")[i % 3]
+            n = int(rng.choice([8, 13, 24]))
+            if kind == "gels":
+                a = _randn(2 * n, n, np.float32, seed=i)
+                b = _randn(2 * n, 2, np.float32, seed=50 + i)
+            elif kind == "posv":
+                a = _spd(n, np.float32, seed=i)
+                b = _randn(n, 2, np.float32, seed=50 + i)
+            else:
+                a = _dd(n, np.float32, seed=i)
+                b = _randn(n, 2, np.float32, seed=50 + i)
+            cases.append((kind, a, b, q.submit(kind, a, b)))
+        for kind, a, b, t in cases:
+            x, info = t.result(timeout=120)
+            assert info == 0
+            assert t.latency_s is not None and t.latency_s >= 0
+            if kind == "gels":
+                r = a.T @ (a @ x - b)
+                assert np.linalg.norm(r) < 1e-2 * np.linalg.norm(a) ** 2
+            else:
+                assert np.linalg.norm(a @ x - b) < \
+                    1e-3 * np.linalg.norm(a) * max(np.linalg.norm(x), 1)
+        q.close()
+
+    def test_solve_many_order_and_occupancy_metrics(self):
+        from slate_tpu import obs
+
+        reqs = serve.make_requests(30, seed=11)
+        results = serve.solve_many(reqs)
+        assert len(results) == len(reqs)
+        for (routine, a, b), (x, info) in zip(reqs, results):
+            assert info == 0
+            assert x.shape == (a.shape[1], b.shape[1])
+        occ = obs.REGISTRY.get("slate_serve_batch_occupancy")
+        assert occ is not None and occ.series(), \
+            "batch occupancy histogram not recorded"
+        tot = obs.REGISTRY.get("slate_serve_requests_total")
+        assert tot is not None and sum(
+            v for v in tot.series().values()) >= 30
+
+    def test_unknown_routine_raises(self):
+        with pytest.raises(slate.SlateError):
+            serve.solve_many([("heev", np.eye(4, dtype=np.float32),
+                               np.ones((4, 1), np.float32))])
+
+    def test_max_wait_flushes_partial_batch(self):
+        q = serve.ServeQueue(policy=BucketPolicy(max_batch=32,
+                                                 max_wait_ms=10.0))
+        a = _dd(8, np.float32, seed=1)
+        b = _randn(8, 1, np.float32, seed=2)
+        t = q.submit("gesv", a, b)       # lone request, far under max_batch
+        x, info = t.result(timeout=60)   # must be served by the wait flush
+        assert info == 0
+        q.close()
+
+    def test_workload_stats_shape(self):
+        stats = serve.run_mixed_workload(num_requests=40, seed=2,
+                                         dims=(8, 13, 24), use_queue=True)
+        assert stats["requests"] == 40
+        assert stats["solves_per_sec"] > 0
+        assert stats["p50_ms"] is not None and stats["p99_ms"] is not None
+        assert stats["p99_ms"] >= stats["p50_ms"]
+        assert stats["bad"] == 0
+        assert stats["misses_after_warmup"] == 0
+
+
+# ---------------------------------------------------------------------------
+# batch-sharded parallel entry
+
+
+class TestBatchedDistributed:
+    def test_gesv_batched_distributed_matches_loop(self):
+        from slate_tpu.parallel import ProcessGrid, gesv_batched_distributed
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs the 8-virtual-device CPU mesh "
+                        "(tests/conftest.py sets it up)")
+        g = ProcessGrid(2, 2)
+        B, n = 8, 12
+        a = np.stack([_dd(n, np.float32, seed=i) for i in range(B)])
+        b = np.stack([_randn(n, 2, np.float32, seed=40 + i)
+                      for i in range(B)])
+        x, perm, info = gesv_batched_distributed(jnp.asarray(a),
+                                                 jnp.asarray(b), g)
+        assert not np.asarray(info).any()
+        for i in range(B):
+            np.testing.assert_allclose(
+                a[i] @ np.asarray(x[i]), b[i], rtol=1e-3, atol=1e-3)
+
+    def test_posv_batched_distributed(self):
+        from slate_tpu.parallel import ProcessGrid, posv_batched_distributed
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        g = ProcessGrid(1, 2)
+        B, n = 4, 10
+        a = np.stack([_spd(n, np.float32, seed=i) for i in range(B)])
+        b = np.stack([_randn(n, 1, np.float32, seed=60 + i)
+                      for i in range(B)])
+        x, info = posv_batched_distributed(jnp.asarray(a), jnp.asarray(b), g)
+        assert not np.asarray(info).any()
+        for i in range(B):
+            np.testing.assert_allclose(a[i] @ np.asarray(x[i]), b[i],
+                                       rtol=1e-2, atol=1e-2)
+
+    def test_batch_not_divisible_raises(self):
+        from slate_tpu.parallel import ProcessGrid, gesv_batched_distributed
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        g = ProcessGrid(1, 2)
+        a = jnp.asarray(np.stack([_dd(8, np.float32)] * 3))
+        b = jnp.asarray(np.stack([_randn(8, 1, np.float32)] * 3))
+        with pytest.raises(slate.SlateError):
+            gesv_batched_distributed(a, b, g)
+
+
+# ---------------------------------------------------------------------------
+# simplified verbs
+
+
+class TestServeVerbs:
+    def test_verb_aliases(self):
+        from slate_tpu import simplified as s
+
+        assert s.batched_lu_solve is serve.gesv_batched
+        assert s.batched_chol_solve is serve.posv_batched
+        assert s.batched_least_squares_solve is serve.gels_batched
+        assert s.solve_many is serve.solve_many

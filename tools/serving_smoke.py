@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""CI serving-smoke: a short mixed workload through the serving queue on CPU.
+
+Gates (the ci.yml ``serving-smoke`` step fails on any):
+
+* the workload runs end-to-end (every request info == 0, finite results),
+* solves/sec > 0 and p50/p99 latency are recorded,
+* ZERO executable-cache misses after warm-up (the compile-count property —
+  a silent recompile in the serving path fails CI here in CPU seconds),
+* the run's metrics.json validates against the shared schema and carries
+  the serving counters (requests, batches, occupancy, cache hits).
+
+Prints one JSON line with the numbers so the CI log doubles as a record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from force_cpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+
+def main() -> int:
+    from slate_tpu import obs, serve
+    from slate_tpu.serve.queue import BucketPolicy
+
+    # compact policy: enough bucket diversity to exercise mixed packing,
+    # small enough that warm-up stays in CI seconds
+    policy = BucketPolicy(dims=(16, 32, 64), nrhs_dims=(2,),
+                          batch_dims=(1, 8, 32), max_batch=32,
+                          max_wait_ms=5.0)
+    stats = serve.run_mixed_workload(
+        num_requests=300, seed=0, policy=policy,
+        dims=(8, 13, 24, 40, 60), use_queue=True, warm=True, check=True)
+
+    failures = []
+    if not stats["solves_per_sec"] > 0:
+        failures.append(f"solves/sec not positive: {stats['solves_per_sec']}")
+    if stats["p50_ms"] is None or stats["p99_ms"] is None:
+        failures.append("p50/p99 latency not recorded")
+    if stats["misses_after_warmup"] != 0:
+        failures.append(f"{stats['misses_after_warmup']} cache misses after "
+                        "warm-up (silent recompiles in the serving path)")
+    if stats["distinct_buckets"] < 4:
+        failures.append(f"only {stats['distinct_buckets']} shape buckets "
+                        "exercised (need >= 4)")
+
+    doc = obs.metrics_doc(source="serving-smoke")
+    try:
+        obs.validate_metrics(doc)
+    except ValueError as e:
+        failures.append(f"metrics.json schema violation: {e}")
+    names = {m["name"] for m in doc["metrics"]}
+    for need in ("slate_serve_requests_total", "slate_serve_batches_total",
+                 "slate_serve_batch_occupancy",
+                 "slate_serve_cache_hits_total",
+                 "slate_serve_latency_seconds"):
+        if need not in names:
+            failures.append(f"metric {need} missing from the registry")
+
+    print(json.dumps({
+        "ok": not failures,
+        "solves_per_sec": stats["solves_per_sec"],
+        "p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"],
+        "requests": stats["requests"],
+        "distinct_buckets": stats["distinct_buckets"],
+        "cache": stats["cache"],
+        "misses_after_warmup": stats["misses_after_warmup"],
+        "warmup_s": (stats["warmup"] or {}).get("seconds"),
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
