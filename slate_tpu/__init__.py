@@ -91,5 +91,7 @@ def id() -> str:  # noqa: A001 - reference name (slate::id)
         return subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
             text=True, timeout=5, cwd=pkg).stdout.strip() or "unknown"
+    # slate-lint: disable=SLT501 -- git metadata probe: the block runs only
+    # subprocess/os calls, the NumericalError taxonomy cannot arise here
     except Exception:
         return "unknown"

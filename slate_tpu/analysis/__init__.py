@@ -1,0 +1,51 @@
+"""slate-lint: JAX-aware static analysis + compile-time collective auditor.
+
+Two tiers, one gate (``python -m slate_tpu.analysis --check``):
+
+* **Tier A — AST linter** (:mod:`.rules` / :mod:`.lint`): ~10
+  codebase-specific rules over the package's sources — tracer hygiene inside
+  jitted/vmapped/shard_mapped cores, recompilation hazards, x64 scope leaks,
+  leftover debug hooks, donation misuse, taxonomy-swallowing ``except``
+  blocks, and missing ``@obs.instrument`` on public drivers.  Accepted
+  pre-existing findings live in ``analysis/baseline.json`` (every entry with
+  a written reason); anything new fails CI.
+* **Tier B — collective race auditor** (:mod:`.collective_audit`): extends
+  ``obs/costaudit.py``'s compiled-HLO walk from counting collectives to
+  *ordering* them — per-participant schedules, channel discipline, and
+  divergent-``lax.cond`` reachability for every AOT-audited distributed
+  routine at P ∈ {2, 4, 8} on the virtual CPU mesh, zero TPU time.
+
+The AST tier is pure-stdlib AST work, and this module keeps it that way:
+the Tier B names below resolve lazily (PEP 562), so importing the linter
+never pulls ``collective_audit`` → ``obs.costaudit``.  (The ``python -m``
+CLI still executes the parent ``slate_tpu`` package init first — that, not
+the analysis package, is what makes jax a runtime requirement of the
+gate.)  Motivation (ISSUE 10): every proof channel this repo built before —
+kernel_plan pins, SCALING_PINS, compile-count pins — was written *after* a
+bug class bit us.  These passes reject the known classes before a TPU
+capture window is spent on them.
+"""
+
+from .findings import Finding, SEVERITIES
+from .rules import RULES, Rule, rule_table
+from .lint import lint_file, lint_package, lint_paths, lint_source
+from . import baseline
+
+#: Tier B re-exports, resolved on first attribute access so the AST tier's
+#: imports stay stdlib-only
+_TIER_B = ("CollectiveEvent", "audit_compiled", "audit_hlo",
+           "audit_routines", "extract_events", "participant_schedules",
+           "verify_events", "verify_participant_schedules")
+
+__all__ = [
+    "Finding", "SEVERITIES", "RULES", "Rule", "rule_table",
+    "lint_file", "lint_package", "lint_paths", "lint_source", "baseline",
+] + list(_TIER_B)
+
+
+def __getattr__(name):
+    if name in _TIER_B:
+        from . import collective_audit
+        return getattr(collective_audit, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -22,8 +22,9 @@ SCALING.md records the caveat next to the numbers.
 
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 #: collective opcodes audited (HLO spellings); ``-start`` async variants are
 #: folded into their base op, ``-done`` halves are skipped (no double count)
@@ -109,12 +110,193 @@ def collective_volume(hlo_text: str) -> Dict[str, Any]:
             "ops": ops}
 
 
+# ---------------------------------------------------------------------------
+# computation-aware HLO parsing (shared with slate_tpu.analysis's collective
+# race auditor, which needs *ordering* and call structure, not just counts)
+
+# computation header: `%name (params) -> type {` or `ENTRY %name (...) ... {`
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{\s*$")
+# one instruction line: `  [ROOT ]%name = <shape> opcode(...), attrs`
+_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"((?:\((?:[^()]|\([^()]*\))*\))|(?:[a-z0-9]+\[[^\]]*\]\S*))"
+    r"\s+([a-z0-9\-]+)\((.*)$")
+# computation references inside an instruction's attribute tail
+_CALLEE_ATTRS = ("to_apply", "body", "condition", "true_computation",
+                 "false_computation", "calls")
+_CALLEE_RE = re.compile(
+    r"\b(" + "|".join(_CALLEE_ATTRS) + r")=%?([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"\bbranch_computations=\{([^}]*)\}")
+_CHANNEL_RE = re.compile(r"\bchannel_id=(\d+)")
+_GROUPS_RE = re.compile(r"\breplica_groups=\{(.*?)\}\}|"
+                        r"\breplica_groups=\{\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"\breplica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"\bsource_target_pairs=\{(.*?)\}\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Instr:
+    """One parsed HLO instruction (name/shape/opcode + raw attribute tail)."""
+
+    name: str
+    shape: str
+    opcode: str
+    tail: str          #: operands + attributes text after the opening paren
+    is_root: bool = False   #: carried the ``ROOT`` marker (computation output)
+
+    def base_opcode(self) -> str:
+        """Opcode with the async ``-start`` suffix folded (an
+        ``all-reduce-start`` is the same rendezvous as its sync spelling);
+        ``-done`` halves are left distinct so walkers can skip them."""
+        return self.opcode[:-6] if self.opcode.endswith("-start") \
+            else self.opcode
+
+    def channel_id(self) -> Optional[int]:
+        m = _CHANNEL_RE.search(self.tail)
+        return int(m.group(1)) if m else None
+
+    def replica_groups(self) -> Optional[Tuple[Tuple[int, ...], ...]]:
+        """Explicit or iota-form replica groups; ``()`` means "all devices in
+        one group" (HLO's ``replica_groups={}``), None when absent."""
+        m = _GROUPS_IOTA_RE.search(self.tail)
+        if m:
+            ngroups, gsize = int(m.group(1)), int(m.group(2))
+            dims = [int(d) for d in m.group(3).split(",")]
+            ids = _iota_ids(dims, m.group(4))
+            return tuple(tuple(ids[g * gsize:(g + 1) * gsize])
+                         for g in range(ngroups))
+        if "replica_groups={}" in self.tail:
+            return ()
+        m = _GROUPS_RE.search(self.tail)
+        if m and m.group(1) is not None:
+            groups = []
+            for part in re.finditer(r"\{([\d,\s]*)\}", "{" + m.group(1) + "}}"):
+                ids = [int(t) for t in part.group(1).split(",") if t.strip()]
+                groups.append(tuple(ids))
+            return tuple(g for g in groups if g)
+        return None
+
+    def source_target_pairs(self) -> Optional[Tuple[Tuple[int, int], ...]]:
+        m = _PAIRS_RE.search(self.tail)
+        if not m:
+            return None
+        return tuple((int(a), int(b))
+                     for a, b in _PAIR_RE.findall("{" + m.group(1) + "}}"))
+
+    def operand_text(self) -> str:
+        """The operand section of the tail — text up to the close paren that
+        matches the opcode's open paren (tuple-shape annotations inside
+        operands nest parens; attrs follow the close)."""
+        depth = 1
+        for i, ch in enumerate(self.tail):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return self.tail[:i]
+        return self.tail
+
+    def operand_refs(self) -> List[str]:
+        """Names of the instructions this one consumes (in operand order)."""
+        return [m.group(1) for m in
+                re.finditer(r"%([\w.\-]+)", self.operand_text())]
+
+    def callees(self) -> Dict[str, List[str]]:
+        """attr -> called computation names (``branch_computations`` folded
+        in as an ordered list)."""
+        out: Dict[str, List[str]] = {}
+        for attr, name in _CALLEE_RE.findall(self.tail):
+            out.setdefault(attr, []).append(name)
+        m = _BRANCHES_RE.search(self.tail)
+        if m:
+            out["branch_computations"] = [
+                t.strip().lstrip("%") for t in m.group(1).split(",")
+                if t.strip()]
+        return out
+
+
+def _iota_ids(dims: List[int], perm_text: Optional[str]) -> List[int]:
+    """Decode HLO's iota replica-group list: iota over prod(dims), reshaped
+    to ``dims``, transposed by ``perm``, flattened."""
+    n = 1
+    for d in dims:
+        n *= d
+    ids = list(range(n))
+    if perm_text:
+        perm = [int(p) for p in perm_text.split(",")]
+        # row-major reshape + transpose without numpy (jax-free module)
+        strides = [1] * len(dims)
+        for i in range(len(dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * dims[i + 1]
+        tdims = [dims[p] for p in perm]
+        tstrides = [strides[p] for p in perm]
+        out = []
+
+        def rec(depth, off):
+            if depth == len(tdims):
+                out.append(ids[off])
+                return
+            for i in range(tdims[depth]):
+                rec(depth + 1, off + i * tstrides[depth])
+
+        rec(0, 0)
+        ids = out
+    return ids
+
+
+_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)")
+
+
+def module_num_partitions(hlo_text: str) -> Optional[int]:
+    """The SPMD partition count from the HloModule header (None if absent)."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else None
+
+
+def parse_computations(hlo_text: str
+                       ) -> Tuple[Dict[str, List[Instr]], Optional[str]]:
+    """Split compiled HLO text into per-computation instruction lists.
+
+    Returns ``(computations, entry_name)`` — instruction order within each
+    computation is the printed order, which for ``is_scheduled=true`` modules
+    (every ``Compiled.as_text()``) is the execution schedule."""
+    comps: Dict[str, List[Instr]] = {}
+    entry: Optional[str] = None
+    current: Optional[str] = None
+    for line in hlo_text.splitlines():
+        m = _COMP_RE.match(line.strip())
+        if m and not line.lstrip().startswith("%param") \
+                and "=" not in line.split("(")[0]:
+            current = m.group(2)
+            comps[current] = []
+            if m.group(1):
+                entry = current
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        if current is None:
+            continue
+        im = _LINE_RE.match(line)
+        if im:
+            comps[current].append(Instr(name=im.group(1), shape=im.group(2),
+                                        opcode=im.group(3), tail=im.group(4),
+                                        is_root=line.lstrip()
+                                        .startswith("ROOT ")))
+    return comps, entry
+
+
 def _cost_analysis(compiled) -> Dict[str, float]:
     """``Compiled.cost_analysis()`` across jax versions (same shim as
     ``slate_tpu.testing.cost_analysis_dict`` — duplicated here so obs does
     not import the tester)."""
     try:
         ca = compiled.cost_analysis()
+    # slate-lint: disable=SLT501 -- version shim: cost_analysis raises
+    # different errors across jax releases; nothing numerical executes here
     except Exception:
         return {}
     if isinstance(ca, (list, tuple)):
@@ -136,6 +318,8 @@ def harvest(compiled) -> Dict[str, Any]:
     ca = _cost_analysis(compiled)
     try:
         hlo = compiled.as_text()
+    # slate-lint: disable=SLT501 -- HLO rendering shim: as_text availability
+    # varies by backend/version; nothing numerical executes here
     except Exception:
         hlo = ""
     vol = collective_volume(hlo)

@@ -350,6 +350,26 @@ def make_grid(nproc: int):
     return ProcessGrid(devices=devs[:nproc])
 
 
+def compile_spec(spec: RoutineSpec, grid):
+    """AOT-compile one audit spec on ``grid``.
+
+    Returns ``(compiled, None)`` on success, else ``(None, problem)`` where
+    ``problem`` is a ``{"skipped": ...}`` or ``{"error": ...}`` dict — the
+    shared front half of :func:`audit_routine` and the collective race
+    auditor (``slate_tpu.analysis.collective_audit``), so both gates compile
+    each routine exactly the same way."""
+    if spec.requires is not None and not spec.requires(grid):
+        return None, {"skipped": "grid constraint "
+                      "(e.g. square-grid-only algorithm)"}
+    try:
+        return spec.build(grid), None
+    # slate-lint: disable=SLT501 -- the audit table renders per-row compile
+    # failures as data; nothing executes in AOT lower/compile, so the
+    # NumericalError taxonomy cannot arise here
+    except Exception as e:   # surface, don't die: the table shows the reason
+        return None, {"error": f"{type(e).__name__}: {e}"}
+
+
 def audit_routine(spec: RoutineSpec, grid) -> Dict[str, Any]:
     """Compile one routine on ``grid`` and harvest its compiled costs.
 
@@ -359,13 +379,9 @@ def audit_routine(spec: RoutineSpec, grid) -> Dict[str, Any]:
     meta = {"routine": spec.name, "module": spec.module,
             "P": grid.size, "grid": f"{grid.p}x{grid.q}",
             "model_flops": spec.model_flops}
-    if spec.requires is not None and not spec.requires(grid):
-        return dict(meta, skipped="grid constraint "
-                    "(e.g. square-grid-only algorithm)")
-    try:
-        compiled = spec.build(grid)
-    except Exception as e:   # surface, don't die: the table shows the reason
-        return dict(meta, error=f"{type(e).__name__}: {e}")
+    compiled, problem = compile_spec(spec, grid)
+    if problem is not None:
+        return dict(meta, **problem)
     out = harvest(compiled)
     out.update(meta)
     return out

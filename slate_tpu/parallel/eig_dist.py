@@ -603,6 +603,7 @@ def _sweep_q_distributed(Vs, taus, phase, n: int, grid: ProcessGrid):
     return Q[:n]
 
 
+@instrument
 def hb2st_q_distributed(Vs, taus, e_c, n: int, grid: ProcessGrid):
     """Q2 of the hb2st chase, rows sharded on the flattened mesh."""
     from ..linalg.eig import _phase_vector

@@ -236,6 +236,10 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
         for i, it in enumerate(items):
             it.ticket._resolve((unpad_result(xs[i], it.n, it.nrhs),
                                 int(infos[i])))
+    # slate-lint: disable=SLT501 -- not a swallow: the exception (taxonomy
+    # included) is re-surfaced on every pending ticket, whose result() call
+    # re-raises it in the submitter's thread; raising here would instead
+    # kill the queue worker and strand the other buckets
     except BaseException as e:  # noqa: BLE001 - surfaced on every ticket
         for it in items:
             if not it.ticket.done():

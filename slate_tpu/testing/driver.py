@@ -48,10 +48,16 @@ def _ref_time(routine: str, params: dict) -> Optional[float]:
     return time.perf_counter() - t0
 
 
-def enable_x64_if_needed(dtypes: Sequence[str]) -> None:
+def x64_scope(dtypes: Sequence[str]):
+    """Scoped x64 for d/z sweeps: ``jax.experimental.enable_x64`` around the
+    sweep instead of the old process-global ``jax.config.update`` (which
+    leaked x64 state across sweep rows and into library callers — the same
+    scoped pattern testing/routines.py's gesv_mixed promotion uses)."""
     if any(t in ("d", "z") for t in dtypes):
-        import jax
-        jax.config.update("jax_enable_x64", True)
+        from jax.experimental import enable_x64
+        return enable_x64()
+    import contextlib
+    return contextlib.nullcontext()
 
 
 def run_sweep(names: Sequence[str],
@@ -69,25 +75,28 @@ def run_sweep(names: Sequence[str],
               progress: Optional[Callable[[TestResult], None]] = None
               ) -> List[TestResult]:
     """Run the cartesian sweep; dtype letters are restored into each result's
-    params for display.  ``ref`` also times the numpy reference (where mapped)."""
-    enable_x64_if_needed(dtypes)
+    params for display.  ``ref`` also times the numpy reference (where mapped).
+
+    d/z sweeps run inside a scoped x64 context (:func:`x64_scope`) so the
+    precision mode cannot leak past this call."""
     results: List[TestResult] = []
-    for routine in names:
-        for (m, n, k) in dims:
-            for nb in nbs:
-                for tletter in dtypes:
-                    params = {"m": m, "n": n, "k": k, "nb": nb,
-                              "dtype": DTYPES[tletter], "kind": kind,
-                              "cond": cond, "seed": seed, "repeat": repeat,
-                              "nrhs": nrhs, "grid": grid}
-                    r = run_routine(routine, params)
-                    if ref and r.ok:
-                        r.ref_time_s = _ref_time(routine, params)
-                    r.params = dict(r.params, dtype=tletter)
-                    results.append(r)
-                    _count_row(r, tletter)
-                    if progress is not None:
-                        progress(r)
+    with x64_scope(dtypes):
+        for routine in names:
+            for (m, n, k) in dims:
+                for nb in nbs:
+                    for tletter in dtypes:
+                        params = {"m": m, "n": n, "k": k, "nb": nb,
+                                  "dtype": DTYPES[tletter], "kind": kind,
+                                  "cond": cond, "seed": seed, "repeat": repeat,
+                                  "nrhs": nrhs, "grid": grid}
+                        r = run_routine(routine, params)
+                        if ref and r.ok:
+                            r.ref_time_s = _ref_time(routine, params)
+                        r.params = dict(r.params, dtype=tletter)
+                        results.append(r)
+                        _count_row(r, tletter)
+                        if progress is not None:
+                            progress(r)
     return results
 
 
@@ -105,5 +114,8 @@ def _count_row(r: TestResult, tletter: str) -> None:
             obs.histogram("slate_tester_row_seconds",
                           "tester row wall time").observe(
                               r.time_s, routine=r.routine, dtype=tletter)
+    # slate-lint: disable=SLT501 -- telemetry guard: the block only mirrors
+    # an already-computed TestResult into the metrics registry; no solve
+    # runs here, and telemetry must never fail a sweep
     except Exception:  # pragma: no cover - telemetry never fails a sweep
         pass

@@ -878,9 +878,23 @@ def run_routine(name: str, params: dict) -> TestResult:
     spec = ROUTINES.get(name)
     if spec is None:
         raise KeyError(f"unknown routine '{name}'; known: {sorted(ROUTINES)}")
+    from ..core.exceptions import NumericalError
+
     try:
         fields = spec["runner"](params, slate)
         return TestResult(routine=name, params=params, **fields)
+    except NumericalError as e:
+        # the taxonomy is reported, never swallowed: the row carries the
+        # exact failure class (SingularMatrixError / ConvergenceError / ...)
+        # plus any info index, so a sweep distinguishes "matrix was singular"
+        # from tester plumbing blowing up
+        info = getattr(e, "info", None)
+        detail = f" info={info}" if info else ""
+        return TestResult(routine=name, params=params, status="error",
+                          message=f"{type(e).__name__}: {e}{detail}")
+    # slate-lint: disable=SLT501 -- intentional catch-all: the tester reports
+    # rows, it doesn't crash mid-sweep; the NumericalError taxonomy is already
+    # reported with its class by the handler above
     except Exception as e:  # noqa: BLE001 — the tester reports, it doesn't crash
         return TestResult(routine=name, params=params, status="error",
                           message=f"{type(e).__name__}: {e}")

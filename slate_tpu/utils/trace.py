@@ -45,6 +45,8 @@ def on() -> None:
     try:
         from .. import native
         native.trace_enable(True)
+    # slate-lint: disable=SLT501 -- optional native runtime: arming the C++
+    # capture buffer may fail in fallback-only environments; no solve runs
     except Exception:  # pragma: no cover - fallback-only environments
         pass
 
@@ -55,6 +57,7 @@ def off() -> None:
     try:
         from .. import native
         native.trace_enable(False)    # disarm the C++ capture buffer too
+    # slate-lint: disable=SLT501 -- optional native runtime (see on())
     except Exception:  # pragma: no cover
         pass
 
@@ -77,6 +80,8 @@ def trace_block(name: str, **attrs):
     try:
         from .. import native as _nat
         _nat.trace_begin(name)
+    # slate-lint: disable=SLT501 -- optional native runtime (see on());
+    # only the import/ctypes call can fail, the traced region runs outside
     except Exception:  # pragma: no cover
         _nat = None
     try:
@@ -208,6 +213,9 @@ def record_phases(routine: str, timers: "Timers | Dict[str, float]") -> None:
     try:    # mirror into the metrics registry (obs absorbs the phase channel)
         from ..obs import on_phases
         on_phases(routine, phases, attempt=cur[1] if cur else None)
+    # slate-lint: disable=SLT501 -- telemetry mirror: the block only copies
+    # an already-computed phase map into the metrics registry; obs must
+    # never break a driver
     except Exception:  # pragma: no cover - obs must never break a driver
         pass
 

@@ -27,6 +27,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: exhaustive sweeps deselected from the tier-1 run "
+        "(`-m 'not slow'`); CI steps run them explicitly where needed")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""slate-lint entry point: static analysis + collective race audit.
+
+Thin wrapper over ``python -m slate_tpu.analysis`` (one shared main) that
+first pins the virtual CPU mesh — the Tier B collective-ordering audit
+AOT-compiles every distributed routine in the obs/scaling registry, so it
+needs ``--xla_force_host_platform_device_count`` set before jax initializes
+(the same bootstrap as tools/gen_scaling.py).
+
+Usage::
+
+    python tools/run_analysis.py --check                   # AST gate
+    python tools/run_analysis.py --collectives --pset 2    # CI ordering audit
+    python tools/run_analysis.py --collectives --pset 2,4,8
+    python tools/run_analysis.py --rules                   # rule table
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from force_cpu import force_cpu_backend
+
+force_cpu_backend(virtual_devices=8)
+
+from slate_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
