@@ -23,12 +23,17 @@ throughput telemetry are the exemplars, PAPERS.md).
 """
 
 from .registry import (REGISTRY, SCHEMA, Counter, Gauge, Histogram,
-                       MetricsRegistry, validate_metrics)
-from .spans import (INSTRUMENT_ATTR, current_span, instrument, on_phases,
-                    scope, span_depth)
+                       MetricsRegistry, quantile_from_counts,
+                       validate_metrics)
+from .spans import (INSTRUMENT_ATTR, SpanHandle, current_span, instrument,
+                    on_phases, scope, span_depth)
 from .costaudit import COLLECTIVE_OPS, collective_volume, harvest, harvest_many
 from .scaling import (AUDIT_N, AUDIT_NB, RoutineSpec, audit_all,
                       audit_routine, make_grid, spec_names, specs)
+from .timeseries import (TIMESERIES_SCHEMA, TimeSeriesSampler,
+                         validate_timeseries)
+from .slo import (SLO, SLOMonitor, SLOVerdict, STATUS_CODES,
+                  default_serve_slos)
 
 
 def counter(name: str, help: str = "") -> Counter:
@@ -63,9 +68,12 @@ def reset() -> None:
 
 __all__ = [
     "REGISTRY", "SCHEMA", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "validate_metrics", "INSTRUMENT_ATTR", "current_span", "instrument",
+    "quantile_from_counts", "validate_metrics", "INSTRUMENT_ATTR",
+    "SpanHandle", "current_span", "instrument",
     "on_phases", "scope", "span_depth", "COLLECTIVE_OPS", "collective_volume",
     "harvest", "harvest_many", "AUDIT_N", "AUDIT_NB", "RoutineSpec",
     "audit_all", "audit_routine", "make_grid", "spec_names", "specs",
+    "TIMESERIES_SCHEMA", "TimeSeriesSampler", "validate_timeseries",
+    "SLO", "SLOMonitor", "SLOVerdict", "STATUS_CODES", "default_serve_slos",
     "counter", "gauge", "histogram", "metrics_doc", "export_metrics", "reset",
 ]

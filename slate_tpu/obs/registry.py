@@ -51,6 +51,35 @@ def _canon(labels: Dict[str, Any]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def quantile_from_counts(buckets: Sequence[float], counts: Sequence[int],
+                         q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0 <= q <= 1) of a bucketed distribution.
+
+    Prometheus-style: find the bucket holding the target rank and linearly
+    interpolate within it (the first bucket interpolates from 0, assuming
+    non-negative observations — true of every duration/count histogram
+    here).  Observations in the overflow slot clamp to the largest bound —
+    the estimator cannot see past its bucket table, so a p99 that lands
+    there reads as ">= last bound", not a fabricated value.  Returns None
+    on an empty distribution.  Shared by :meth:`Histogram.quantile` and the
+    per-window delta estimation in :mod:`.timeseries`."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0.0
+    for i, ub in enumerate(buckets):
+        c = counts[i]
+        if seen + c >= rank and c > 0:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            frac = (rank - seen) / c
+            return lo + (ub - lo) * min(max(frac, 0.0), 1.0)
+        seen += c
+    return float(buckets[-1])     # rank fell in the overflow slot
+
+
 class _Metric:
     """Base: a named family of label-keyed series."""
 
@@ -149,6 +178,16 @@ class Histogram(_Metric):
         return {"buckets": list(self.buckets),
                 "counts": list(state["counts"]),
                 "sum": state["sum"], "count": state["count"]}
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Bucket-interpolated ``q``-quantile (0 <= q <= 1) of one series —
+        p50/p99 derivable live, not just end-of-run (see
+        :func:`quantile_from_counts` for the estimator and its clamping at
+        the overflow slot).  None when the series has no observations."""
+        state = self.labeled(**labels)
+        if state is None:
+            return None
+        return quantile_from_counts(self.buckets, state["counts"], q)
 
 
 class MetricsRegistry:

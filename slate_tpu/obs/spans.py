@@ -51,8 +51,28 @@ def span_depth() -> int:
     return len(getattr(_stack, "spans", ()))
 
 
+class SpanHandle:
+    """The object a :func:`scope` yields: a slot for the span's result.
+
+    With ``device_sync=True`` on the scope, the recorded duration includes a
+    ``block_until_ready()`` on whatever was handed to :meth:`set_result` —
+    without it, an async-dispatch backend would close the span at *dispatch*
+    time and the execute histogram would measure queue depth, not compute.
+    """
+
+    __slots__ = ("_result",)
+
+    def __init__(self):
+        self._result = None
+
+    def set_result(self, value) -> None:
+        """Attach the span's device result (blocked on at close when the
+        scope was opened with ``device_sync=True``)."""
+        self._result = value
+
+
 @contextlib.contextmanager
-def scope(routine: str, **labels):
+def scope(routine: str, device_sync: bool = False, **labels):
     """Open an observability span around a routine invocation.
 
     ::
@@ -62,8 +82,19 @@ def scope(routine: str, **labels):
 
     Labels are stringified; the span's duration lands in the
     ``slate_span_seconds`` histogram and its count in ``slate_spans_total``.
+
+    ``device_sync=True`` (opt-in; the serve execute stage is the intended
+    caller) makes the span block on the result attached via the yielded
+    :class:`SpanHandle` before closing, so the duration is dispatch+compute
+    rather than dispatch alone, and stamps a ``device_sync="true"`` label so
+    synced and unsynced timings never mix in one series::
+
+        with obs.scope("serve.execute", device_sync=True) as sp:
+            sp.set_result(driver(A, B))
     """
     labels = {k: str(v) for k, v in labels.items() if v is not None}
+    if device_sync:
+        labels["device_sync"] = "true"
     parent = current_span()
     if parent is not None:
         labels.setdefault("parent", parent)
@@ -71,10 +102,13 @@ def scope(routine: str, **labels):
     if stack is None:
         stack = _stack.spans = []
     stack.append(routine)
+    handle = SpanHandle()
     t0 = time.perf_counter()
     try:
         with trace_block(routine, **labels):
-            yield
+            yield handle
+            if device_sync and hasattr(handle._result, "block_until_ready"):
+                handle._result.block_until_ready()
     finally:
         dur = time.perf_counter() - t0
         stack.pop()

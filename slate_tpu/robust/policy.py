@@ -143,10 +143,19 @@ def run_ladder(routine: str, rungs: Sequence[Rung],
             break
     if report is not None:
         report.recovered = bool(ok)
-    if not ok and raise_on_exhaust:
-        raise ConvergenceError(
-            f"{routine}: escalation ladder "
-            f"{tuple(r.name for r in rungs)} exhausted", report=report)
+    if not ok:
+        # exhaustion is a first-class event: the flight recorder and the
+        # timeline both need to see "the ladder ran out" distinctly from the
+        # individual fallback steps (which also fire on *successful*
+        # escalations).  Under a serving request scope the trace event
+        # carries the request's trace_id automatically.
+        trace_event("ladder_exhausted", routine=routine,
+                    rungs=",".join(r.name for r in rungs))
+        _count("slate_robust_ladder_exhausted_total", routine=routine)
+        if raise_on_exhaust:
+            raise ConvergenceError(
+                f"{routine}: escalation ladder "
+                f"{tuple(r.name for r in rungs)} exhausted", report=report)
     return payload
 
 

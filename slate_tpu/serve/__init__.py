@@ -34,15 +34,18 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from .batched import gels_batched, gesv_batched, posv_batched
+from .batched import (gels_batched, gesv_batched, last_escalations,
+                      posv_batched)
 from .cache import ExecutableCache, default_cache, reset_cache
+from .flight import FlightRecord, FlightRecorder, validate_flight
 from .queue import (BucketPolicy, ServeQueue, Ticket, pad_request,
                     solve_many, unpad_result)
 from .workload import make_requests, run_mixed_workload
 
 __all__ = [
-    "gesv_batched", "posv_batched", "gels_batched",
+    "gesv_batched", "posv_batched", "gels_batched", "last_escalations",
     "ExecutableCache", "default_cache", "reset_cache",
+    "FlightRecord", "FlightRecorder", "validate_flight",
     "BucketPolicy", "ServeQueue", "Ticket", "pad_request", "unpad_result",
     "solve_many", "make_requests", "run_mixed_workload",
     "submit", "default_queue", "shutdown",

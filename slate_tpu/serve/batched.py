@@ -36,7 +36,8 @@ zero-overhead call.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -53,8 +54,22 @@ from ..obs import instrument
 from ..robust import (RetryPolicy, Rung, SolveReport, active, inject,
                       run_ladder)
 from ..robust.faults import count_event
-from ..utils.trace import trace_event
+from ..utils.trace import batch_request_id, request_scope, trace_event
 from .cache import ExecutableCache, default_cache
+
+# thread-local side channel: per-element escalation outcomes of this
+# thread's most recent batched driver call.  The serving queue reads it
+# (``last_escalations``) to fill flight-recorder records with the ladder
+# rungs a request actually took — without threading report objects through
+# the hot path or changing the drivers' return arity.
+_tl = threading.local()
+
+
+def last_escalations() -> Dict[int, Dict[str, Any]]:
+    """``{batch element: {"rungs": (...), "recovered": bool}}`` for the most
+    recent batched driver call on this thread ({} when nothing escalated)."""
+    return {k: dict(v) for k, v in
+            (getattr(_tl, "escalations", None) or {}).items()}
 
 #: routine name -> pure single-matrix core (the vmapped rung-1 program)
 CORES = {
@@ -143,24 +158,35 @@ def _escalate(routine: str, core: Callable, a0, b, idx: Sequence[int],
     in place (functionally) for each recovered element.  Returns the updated
     ``(out_arrays, info)``."""
     policy = RetryPolicy.from_options(opts, routine)
+    escal = getattr(_tl, "escalations", None)
     for i in idx:
-        trace_event("fallback", routine=routine, to="elementwise", elem=int(i))
-        count_event("slate_robust_fallbacks_total", routine=routine,
-                    to="elementwise")
-        state = {}
+        # re-open the owning serving request's scope (if the queue published
+        # a batch id map) so the fallback/retry/exhaustion events below carry
+        # that request's trace_id in the timeline
+        with request_scope(batch_request_id(int(i))):
+            trace_event("fallback", routine=routine, to="elementwise",
+                        elem=int(i))
+            count_event("slate_robust_fallbacks_total", routine=routine,
+                        to="elementwise")
+            state = {}
 
-        def elem_rung(i=i):
-            ai = inject(routine, a0[i])   # pristine operand, counter advances
-            out = core(ai, b[i])
-            einfo = out[-1]
-            ok = bool((einfo == 0)
-                      & jnp.all(jnp.isfinite(as_array(out[0]))))
-            state["out"] = out
-            return out, ok
+            def elem_rung(i=i):
+                ai = inject(routine, a0[i])  # pristine operand, counter moves
+                out = core(ai, b[i])
+                einfo = out[-1]
+                ok = bool((einfo == 0)
+                          & jnp.all(jnp.isfinite(as_array(out[0]))))
+                state["out"] = out
+                state["ok"] = ok
+                return out, ok
 
-        report = reports[i] if reports is not None else None
-        run_ladder(routine, [Rung("elementwise", elem_rung)], policy, report)
-        out = state["out"]
+            report = reports[i] if reports is not None else None
+            run_ladder(routine, [Rung("elementwise", elem_rung)], policy,
+                       report)
+            out = state["out"]
+            if escal is not None:
+                escal[int(i)] = {"rungs": ("batched", "elementwise"),
+                                 "recovered": bool(state["ok"])}
         for slot, val in zip(out_arrays, out[:-1]):
             slot[0] = slot[0].at[i].set(val)
         info = info.at[i].set(out[-1])
@@ -169,6 +195,7 @@ def _escalate(routine: str, core: Callable, a0, b, idx: Sequence[int],
 
 def _solve_batched(routine: str, A, B, opts, cache, donate):
     """Shared driver body; returns (payload tuple, info[, reports])."""
+    _tl.escalations = {}                 # fresh side channel for this call
     opts = Options.make(opts)
     a0, b, squeeze = _as_batch(A, B, routine)
     batch = a0.shape[0]

@@ -61,6 +61,10 @@ class ExecutableCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # per-thread record of the most recent get(): the serving queue reads
+        # it to split a request's "cache" stage (lookup + possible compile)
+        # from its "execute" stage, and to stamp hit/miss on flight records
+        self._calls = threading.local()
 
     # -- keying --------------------------------------------------------------
     @staticmethod
@@ -92,6 +96,7 @@ class ExecutableCache:
 
         if donate and jax.default_backend() == "cpu":
             donate = False
+        t_lookup = time.perf_counter()
         key = self.make_key(routine, args, opts, donate)
         labels = self._labels(routine, args)
         with self._lock:
@@ -101,6 +106,9 @@ class ExecutableCache:
                 self.hits += 1
                 _counter("slate_serve_cache_hits_total",
                          "executable-cache hits").inc(**labels)
+                self._calls.last = {
+                    "hit": True,
+                    "seconds": time.perf_counter() - t_lookup}
                 return ex
             self.misses += 1        # counted under the lock, like hits
         # compile outside the lock: a long XLA compile must not serialize
@@ -130,7 +138,17 @@ class ExecutableCache:
 
             _obs.gauge("slate_serve_cache_size",
                        "live executables in the cache").set(len(self._table))
+        self._calls.last = {"hit": False,
+                            "seconds": time.perf_counter() - t_lookup,
+                            "compile_seconds": time.perf_counter() - t0}
         return ex
+
+    def last_lookup(self) -> Optional[Dict[str, Any]]:
+        """This thread's most recent ``get()``: ``{"hit", "seconds"[,
+        "compile_seconds"]}`` — the serving queue's cache-stage probe (None
+        before any call on this thread)."""
+        last = getattr(self._calls, "last", None)
+        return dict(last) if last is not None else None
 
     def warmup(self, routine: str, build: Callable,
                shapes: Sequence[Tuple[Tuple[int, ...], Any]],

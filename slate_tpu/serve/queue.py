@@ -28,6 +28,8 @@ queue-to-result latency in the obs registry (``slate_serve_*``).
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -38,8 +40,10 @@ import jax.numpy as jnp
 
 from ..core.exceptions import SlateError, slate_assert
 from ..core.types import Options
+from ..utils import trace
 from . import batched as _batched
 from .cache import ExecutableCache, default_cache
+from .flight import FlightRecord, FlightRecorder
 
 #: queue-able routines -> batched driver
 DRIVERS = {
@@ -49,6 +53,19 @@ DRIVERS = {
 }
 
 _OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+#: stage-latency histogram bounds — serving stages live in the us..s range,
+#: far below the registry default's multi-minute top end
+_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+_TRACE_SEQ = itertools.count(1)
+
+
+def _new_trace_id(routine: str) -> str:
+    """Process-unique request trace id (stitches one request's spans,
+    ladder events, and flight record across the chrome-trace)."""
+    return f"{routine}-{os.getpid():x}-{next(_TRACE_SEQ):06d}"
 
 
 def _obs():
@@ -151,10 +168,20 @@ def unpad_result(x, n: int, nrhs: int):
 
 
 class Ticket:
-    """Async handle for one submitted request."""
+    """Async handle for one submitted request.
+
+    Beyond the result, a ticket carries the request's telemetry: a
+    process-unique ``trace_id`` (every span/event of this request in the
+    chrome-trace carries it), per-stage latencies in ``stages``
+    (submit / queue_wait / pad / cache / execute / resolve, seconds),
+    the executable-cache verdict (``cache_hit``), and the escalation-ladder
+    rungs taken (``ladder`` / ``exhausted``) — the same fields the flight
+    recorder persists.
+    """
 
     __slots__ = ("routine", "shape", "_event", "_value", "_error",
-                 "t_submit", "latency_s")
+                 "t_submit", "t_submit_unix", "latency_s", "trace_id",
+                 "stages", "cache_hit", "ladder", "exhausted")
 
     def __init__(self, routine: str, shape):
         self.routine = routine
@@ -163,7 +190,13 @@ class Ticket:
         self._value = None
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        self.t_submit_unix = time.time()
         self.latency_s: Optional[float] = None
+        self.trace_id = _new_trace_id(routine)
+        self.stages: Dict[str, float] = {}
+        self.cache_hit: Optional[bool] = None
+        self.ladder: Tuple[str, ...] = ()
+        self.exhausted = False
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -198,6 +231,7 @@ def _normalize_request(policy: BucketPolicy, routine: str, a, b
     ``solve_many``): host-side asarray (operands stay off-device until the
     packer's stacked transfer), 1-D rhs promotion, bucket lookup, and the
     ``slate_serve_requests_total`` sample."""
+    t0 = time.perf_counter()
     if routine not in DRIVERS:
         raise SlateError(f"serve: unknown routine {routine!r}; "
                          f"expected one of {sorted(DRIVERS)}")
@@ -211,43 +245,144 @@ def _normalize_request(policy: BucketPolicy, routine: str, a, b
         routine=routine, bucket="x".join(str(d) for d in bucket))
     item = _Pending(Ticket(routine, (m, n, b.shape[-1])), a, b,
                     n, b.shape[-1])
+    t1 = time.perf_counter()
+    item.ticket.stages["submit"] = t1 - t0
+    trace.emit_span("serve.submit", t0, t1, trace_id=item.ticket.trace_id,
+                    routine=routine,
+                    bucket="x".join(str(d) for d in bucket))
     return (routine, bucket, str(a.dtype)), item
+
+
+def _stage_hist(obs, name: str, help: str):
+    return obs.histogram(name, help, buckets=_STAGE_BUCKETS)
+
+
+def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
+                   n_real: int, error: Optional[str] = None) -> FlightRecord:
+    tk = it.ticket
+    info = None
+    if error is None and tk._value is not None:
+        info = int(tk._value[1])
+    return FlightRecord(
+        trace_id=tk.trace_id, routine=routine, bucket=bucket_s,
+        dtype=str(it.a.dtype), t_submit_unix=tk.t_submit_unix,
+        stages=dict(tk.stages), info=info, cache_hit=tk.cache_hit,
+        batch=nb, occupancy=n_real / max(nb, 1), ladder=tk.ladder,
+        exhausted=tk.exhausted, error=error)
 
 
 def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
                       items: Sequence[_Pending], opts: Options,
-                      cache: ExecutableCache, policy: BucketPolicy) -> None:
-    """Pad + pack one bucket's requests, run the batched driver, distribute."""
+                      cache: ExecutableCache, policy: BucketPolicy,
+                      flight: Optional[FlightRecorder] = None) -> None:
+    """Pad + pack one bucket's requests, run the batched driver, distribute.
+
+    Stage decomposition (per request, into ``ticket.stages`` + the
+    ``slate_serve_*_seconds`` histograms + synthesized chrome-trace spans):
+    queue_wait (submit -> batch start, per request), pad (host-side pack),
+    cache (executable lookup + possible compile, from the cache's per-call
+    probe), execute (dispatch + compute + verdict sync, the driver call with
+    the cache share subtracted), resolve (unpad + ticket delivery).
+    """
     obs = _obs()
+    bucket_s = "x".join(str(d) for d in bucket)
+    labels = {"routine": routine, "bucket": bucket_s}
     t0 = time.perf_counter()
     nb = policy.round_batch(len(items))
+    for it in items:                      # stage: queue wait (per request)
+        wait = t0 - it.ticket.t_submit
+        it.ticket.stages["queue_wait"] = wait
+        _stage_hist(obs, "slate_serve_queue_wait_seconds",
+                    "submit-to-batch-start wait per request").observe(
+                        wait, routine=routine)
+    escal: Dict[int, Dict[str, Any]] = {}
+    t_pad0 = t_pad1 = t_exec1 = None
+    cache_s = 0.0
+    cache_info = None
+    res_spans: List[Tuple[float, float]] = []
     try:
+        t_pad0 = time.perf_counter()      # stage: pad + pack
         padded = [pad_request(routine, it.a, it.b, bucket) for it in items]
         while len(padded) < nb:
             padded.append(padded[-1])       # repeat-pad the batch axis
         # one host->device transfer per packed operand, not one per request
         A = jnp.asarray(np.stack([p[0] for p in padded]))
         B = jnp.asarray(np.stack([p[1] for p in padded]))
-        out = DRIVERS[routine](A, B, opts, cache=cache)
-        x, info = out[0], out[-1]
-        x.block_until_ready()
+        t_pad1 = time.perf_counter()
+        _stage_hist(obs, "slate_serve_pad_seconds",
+                    "host-side pad+pack time per batch").observe(
+                        t_pad1 - t_pad0, **labels)
+        # stage: cache + execute.  The batch-level span blocks on the device
+        # result before closing (device_sync) so async dispatch cannot
+        # masquerade as compute time; the per-element escalation below the
+        # driver sees the owning request ids via the batch scope.
+        with trace.batch_request_scope([it.ticket.trace_id for it in items]):
+            # ("routine" is scope()'s span-name slot; the serving routine
+            # rides as the "driver" label instead)
+            with obs.scope("serve.execute_batch", device_sync=True,
+                           driver=routine, bucket=bucket_s) as sp:
+                out = DRIVERS[routine](A, B, opts, cache=cache)
+                x, info = out[0], out[-1]
+                sp.set_result(x)
+            escal = _batched.last_escalations()
+        t_exec1 = time.perf_counter()
+        cache_info = cache.last_lookup()
+        cache_s = (cache_info or {}).get("seconds", 0.0)
+        exec_s = max(t_exec1 - t_pad1 - cache_s, 0.0)
+        _stage_hist(obs, "slate_serve_execute_seconds",
+                    "device execute time per batch (cache share "
+                    "subtracted, result blocked on)").observe(
+                        exec_s, **labels)
         xs = np.asarray(x)
         infos = np.asarray(info)
+        t_res = time.perf_counter()       # stage: unpad + resolve
         for i, it in enumerate(items):
-            it.ticket._resolve((unpad_result(xs[i], it.n, it.nrhs),
-                                int(infos[i])))
+            tk = it.ticket
+            tk.stages["pad"] = t_pad1 - t_pad0
+            tk.stages["cache"] = cache_s
+            tk.stages["execute"] = exec_s
+            tk.cache_hit = (cache_info or {}).get("hit")
+            e = escal.get(i)
+            if e is not None:
+                tk.ladder = tuple(e["rungs"])
+                tk.exhausted = not e["recovered"]
+            if int(infos[i]) != 0:
+                tk.exhausted = True
+            # per-request interval: this request's OWN unpad, stamped before
+            # delivery so the waiter sees a complete stage map (only the
+            # Event.set itself falls outside the measured interval)
+            value = (unpad_result(xs[i], it.n, it.nrhs), int(infos[i]))
+            now = time.perf_counter()
+            tk.stages["resolve"] = now - t_res
+            res_spans.append((t_res, now))
+            t_res = now
+            tk._resolve(value)
     # slate-lint: disable=SLT501 -- not a swallow: the exception (taxonomy
     # included) is re-surfaced on every pending ticket, whose result() call
     # re-raises it in the submitter's thread; raising here would instead
     # kill the queue worker and strand the other buckets
     except BaseException as e:  # noqa: BLE001 - surfaced on every ticket
+        # the satellite contract: a worker-thread failure is visible in the
+        # registry, the timeline, and the flight recorder — not only through
+        # whichever ticket happens to be awaited first
+        obs.counter("slate_serve_worker_errors_total",
+                    "worker-thread exceptions while serving a batch").inc(
+                        error=type(e).__name__, **labels)
+        trace.trace_event("worker_error", error=type(e).__name__,
+                          **labels)
+        last_rec = None
         for it in items:
             if not it.ticket.done():
                 it.ticket._resolve(error=e)
+            if flight is not None:
+                last_rec = _flight_record(it, routine, bucket_s, nb,
+                                          len(items),
+                                          error=f"{type(e).__name__}: {e}")
+                flight.record(last_rec)
+        if flight is not None and last_rec is not None:
+            flight.on_exhaustion(last_rec, reason="worker_error")
         return
     finally:
-        labels = {"routine": routine,
-                  "bucket": "x".join(str(d) for d in bucket)}
         obs.counter("slate_serve_batches_total",
                     "executed batches").inc(**labels)
         obs.histogram("slate_serve_batch_occupancy",
@@ -257,10 +392,34 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
         obs.histogram("slate_serve_batch_seconds",
                       "wall time per executed batch").observe(
                           time.perf_counter() - t0, **labels)
-    for it in items:
-        obs.histogram("slate_serve_latency_seconds",
-                      "submit-to-result latency per request").observe(
-                          it.ticket.latency_s, routine=routine)
+    exhausted_rec = None
+    for i, it in enumerate(items):
+        tk = it.ticket
+        _stage_hist(obs, "slate_serve_latency_seconds",
+                    "submit-to-result latency per request").observe(
+                        tk.latency_s, routine=routine)
+        if trace.is_on():
+            # retrospective per-request stage spans: one request's lifeline,
+            # stitchable from the interleaved timeline by args.trace_id
+            common = {"trace_id": tk.trace_id, "routine": routine,
+                      "bucket": bucket_s}
+            trace.emit_span("serve.queue_wait", tk.t_submit, t0, **common)
+            trace.emit_span("serve.pad", t_pad0, t_pad1, **common)
+            trace.emit_span("serve.cache", t_pad1, t_pad1 + cache_s,
+                            hit=tk.cache_hit, **common)
+            trace.emit_span("serve.execute", t_pad1 + cache_s, t_exec1,
+                            **common)
+            trace.emit_span("serve.resolve", *res_spans[i], **common)
+        if flight is not None:
+            rec = _flight_record(it, routine, bucket_s, nb, len(items))
+            flight.record(rec)
+            if tk.exhausted:
+                exhausted_rec = rec
+    if flight is not None and exhausted_rec is not None:
+        # one dump per batch, after every record is in the ring — a batch of
+        # 32 failing elements must not rewrite the ring file 32 times on the
+        # serving worker thread (the worker-error path dedupes the same way)
+        flight.on_exhaustion(exhausted_rec)
 
 
 class ServeQueue:
@@ -281,10 +440,13 @@ class ServeQueue:
     def __init__(self, policy: Optional[BucketPolicy] = None,
                  opts: Optional[Options] = None,
                  cache: Optional[ExecutableCache] = None,
-                 start: bool = True):
+                 start: bool = True,
+                 flight: Optional[FlightRecorder] = None):
         self.policy = policy or BucketPolicy()
         self.opts = Options.make(opts)
         self.cache = default_cache() if cache is None else cache
+        self.flight = FlightRecorder() if flight is None else flight
+        self._slo_monitor = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[tuple, List[_Pending]] = {}
@@ -381,11 +543,41 @@ class ServeQueue:
                         _run_bucket_batch(
                             routine, bucket,
                             items[chunk0:chunk0 + self.policy.max_batch],
-                            self.opts, self.cache, self.policy)
+                            self.opts, self.cache, self.policy,
+                            flight=self.flight)
             finally:
                 with self._cv:
                     self._inflight -= sum(len(i) for _, i in work)
                     self._cv.notify_all()
+
+    # -- telemetry -----------------------------------------------------------
+    def dump_flight(self, path: Optional[str] = None) -> str:
+        """Write the flight recorder's ring as JSON (on-demand postmortem);
+        returns the path."""
+        return self.flight.dump(path)
+
+    def attach_slo(self, monitor) -> None:
+        """Attach an :class:`~slate_tpu.obs.slo.SLOMonitor`; its verdicts
+        become this queue's admission-control signal
+        (:meth:`slo_verdicts` / :meth:`slo_status`)."""
+        self._slo_monitor = monitor
+
+    def slo_verdicts(self):
+        """Evaluate the attached monitor now ([] when none attached); also
+        refreshes the ``slate_slo_*`` gauges."""
+        return self._slo_monitor.evaluate() if self._slo_monitor else []
+
+    def slo_status(self) -> Dict[str, int]:
+        """The last published SLO verdict codes, straight from the registry
+        gauges (``{slo name: 0 ok / 1 warning / 2 breach / -1 no data}``) —
+        readable whether this queue, another queue, or an external monitor
+        evaluated them.  The hook ROADMAP item 2(c)'s admission control
+        reads before admitting a request."""
+        g = _obs().REGISTRY.get("slate_slo_status")
+        if g is None:
+            return {}
+        return {dict(key).get("slo", "?"): int(val)
+                for key, val in g.series().items()}
 
     # -- lifecycle -----------------------------------------------------------
     def flush(self, timeout: float = 30.0) -> None:
@@ -419,7 +611,8 @@ class ServeQueue:
 def solve_many(requests: Sequence[Tuple[str, Any, Any]],
                opts: Optional[Options] = None,
                policy: Optional[BucketPolicy] = None,
-               cache: Optional[ExecutableCache] = None
+               cache: Optional[ExecutableCache] = None,
+               flight: Optional[FlightRecorder] = None
                ) -> List[Tuple[np.ndarray, int]]:
     """Synchronous mixed-traffic verb: bucket, pack, and solve ``requests``
     (``(routine, a, b)`` triples) in one pass, returning ``(x, info)`` per
@@ -438,7 +631,7 @@ def solve_many(requests: Sequence[Tuple[str, Any, Any]],
         for c0 in range(0, len(pairs), policy.max_batch):
             chunk = pairs[c0:c0 + policy.max_batch]
             _run_bucket_batch(routine, bucket, [it for _, it in chunk],
-                              opts, cache, policy)
+                              opts, cache, policy, flight=flight)
             for i, it in chunk:
                 results[i] = it.ticket.result(timeout=0)
     return results  # type: ignore[return-value]

@@ -14,12 +14,13 @@ occupancy statistics.  Used by ``bench.py --child serve_mixed`` and the CI
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core.types import Options
 from .cache import ExecutableCache
+from .flight import FlightRecorder
 from .queue import BucketPolicy, ServeQueue, solve_many
 
 #: default mixed-traffic dimension pool — spans 4+ policy buckets
@@ -70,7 +71,11 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                        routines: Sequence[str] = DEFAULT_ROUTINES,
                        use_queue: bool = True,
                        warm: bool = True,
-                       check: bool = True) -> Dict[str, Any]:
+                       check: bool = True,
+                       flight: Optional[FlightRecorder] = None,
+                       return_tickets: bool = False,
+                       after_warmup: Optional[Callable[[ServeQueue], None]]
+                       = None) -> Dict[str, Any]:
     """Generate, warm up, and serve a mixed workload; return the stats dict.
 
     Two passes over the same request stream: the warm-up pass compiles every
@@ -79,7 +84,14 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
     measured pass times steady-state serving.  ``use_queue=True`` routes
     through the async :class:`ServeQueue` (latency includes queue wait);
     False uses the synchronous :func:`solve_many` packer.  ``check=True``
-    verifies every request's info == 0 and result finite."""
+    verifies every request's info == 0 and result finite.
+
+    Telemetry hooks (the CI smoke is the caller): ``flight`` hands the queue
+    a specific :class:`FlightRecorder`; ``after_warmup(q)`` runs between the
+    warm-up sweep and the measured pass (start a sampler / enable tracing
+    there, so warm-up compiles stay out of the steady-state windows);
+    ``return_tickets=True`` adds the queue pass's tickets to the stats
+    (trace-stitch checks need their trace ids and stage maps)."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = ExecutableCache()
@@ -87,7 +99,8 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
     combos = sorted({(r, a.shape[0], a.shape[1], b.shape[1])
                      for r, a, b in reqs})
 
-    q = ServeQueue(policy=policy, opts=opts, cache=cache, start=use_queue)
+    q = ServeQueue(policy=policy, opts=opts, cache=cache, start=use_queue,
+                   flight=flight)
     warm_stats = None
     if warm:
         t0 = time.perf_counter()
@@ -95,15 +108,19 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
         warm_stats = {"seconds": round(time.perf_counter() - t0, 3),
                       **cache.stats()}
     miss0, hit0 = cache.misses, cache.hits
+    if after_warmup is not None:
+        after_warmup(q)
 
     t0 = time.perf_counter()
     latencies: List[float] = []
+    tickets: List[Any] = []
     if use_queue:
         tickets = [q.submit(r, a, b) for r, a, b in reqs]
         results = [t.result(timeout=300.0) for t in tickets]
         latencies = [t.latency_s for t in tickets]
     else:
-        items = solve_many(reqs, opts=opts, policy=policy, cache=cache)
+        items = solve_many(reqs, opts=opts, policy=policy, cache=cache,
+                           flight=flight)
         results = list(items)
     wall = time.perf_counter() - t0
     q.close()
@@ -139,4 +156,6 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
         # solve_many path: per-request latency is the packed batch's wall
         # time, recorded on each ticket by the runner — not collected here
         stats["p50_ms"] = stats["p99_ms"] = None
+    if return_tickets:
+        stats["tickets"] = tickets
     return stats

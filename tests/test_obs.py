@@ -313,6 +313,416 @@ class TestCostAudit:
         assert agg["collective_bytes"] == 0
 
 
+class TestQuantile:
+    def test_interpolation_midpoints(self):
+        # uniform mass in (0,1],(1,2],(2,3],(3,4]: p50 is the 2.0 edge,
+        # p25 interpolates to the middle of the first bucket
+        bs, cs = [1.0, 2.0, 3.0, 4.0], [10, 10, 10, 10, 0]
+        assert obs.quantile_from_counts(bs, cs, 0.5) == pytest.approx(2.0)
+        assert obs.quantile_from_counts(bs, cs, 0.25) == pytest.approx(1.0)
+        assert obs.quantile_from_counts(bs, cs, 0.125) == pytest.approx(0.5)
+
+    def test_empty_and_bad_q(self):
+        assert obs.quantile_from_counts([1.0], [0, 0], 0.5) is None
+        with pytest.raises(ValueError):
+            obs.quantile_from_counts([1.0], [1, 0], 1.5)
+
+    def test_overflow_clamps_to_top_bound(self):
+        # everything past the table: the estimator reports the last bound,
+        # not a fabricated value
+        assert obs.quantile_from_counts([1.0, 2.0], [0, 0, 5], 0.99) == 2.0
+
+    def test_p99_from_buckets_vs_exact_within_bucket_width(self):
+        """The acceptance tolerance: bucket-estimated p50/p99 vs the exact
+        percentile, within the width of the containing bucket."""
+        rng = np.random.default_rng(3)
+        values = rng.lognormal(mean=-3.0, sigma=1.0, size=4000)
+        bounds = tuple(np.geomspace(1e-4, 10.0, 40))
+        h = obs.histogram("t_q", buckets=bounds)
+        for v in values:
+            h.observe(float(v), routine="r")
+        for q in (0.50, 0.99):
+            est = h.quantile(q, routine="r")
+            exact = float(np.quantile(values, q))
+            # the containing bucket's width bounds the estimator error
+            idx = int(np.searchsorted(bounds, exact))
+            lo = bounds[idx - 1] if idx > 0 else 0.0
+            hi = bounds[min(idx, len(bounds) - 1)]
+            assert abs(est - exact) <= (hi - lo) + 1e-12, \
+                f"q={q}: est {est} vs exact {exact}"
+
+    def test_histogram_quantile_none_for_unknown_series(self):
+        h = obs.histogram("t_q2")
+        assert h.quantile(0.5, routine="never") is None
+
+
+class TestTimeseries:
+    def test_window_rate_math_exact(self):
+        """Counter rate = delta / wall window duration, with explicit
+        timestamps so the arithmetic is exact."""
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        assert sampler.sample(now=100.0) is None      # baseline
+        obs.counter("t_ts_total").inc(5.0, routine="r")
+        w = sampler.sample(now=102.0)
+        assert w["duration_s"] == pytest.approx(2.0)
+        (c,) = [c for c in w["counters"] if c["name"] == "t_ts_total"]
+        assert c["delta"] == pytest.approx(5.0)
+        assert c["rate"] == pytest.approx(2.5)
+        assert c["labels"] == {"routine": "r"}
+        # a quiet series stays out of the next window
+        w2 = sampler.sample(now=103.0)
+        assert not [c for c in w2["counters"] if c["name"] == "t_ts_total"]
+
+    def test_ring_bounded_and_indexed(self):
+        sampler = obs.TimeSeriesSampler(interval_s=1.0, max_windows=3)
+        sampler.sample(now=0.0)
+        for i in range(6):
+            obs.counter("t_ring").inc()
+            sampler.sample(now=float(i + 1))
+        ws = sampler.windows()
+        assert len(ws) == 3                       # ring evicted the oldest
+        assert [w["index"] for w in ws] == [3, 4, 5]
+
+    def test_histogram_window_delta_and_quantiles(self):
+        h = obs.histogram("t_ts_h", buckets=(0.1, 1.0, 10.0))
+        h.observe(0.05, routine="r")              # pre-baseline observation
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        sampler.sample(now=10.0)
+        for v in (0.5, 0.6, 5.0, 50.0):
+            h.observe(v, routine="r")
+        w = sampler.sample(now=11.0)
+        (hs,) = [e for e in w["histograms"] if e["name"] == "t_ts_h"]
+        assert hs["count"] == 4                   # the delta, not the total
+        assert hs["counts"] == [0, 2, 1, 1]
+        assert hs["rate"] == pytest.approx(4.0)
+        assert 0.1 <= hs["p50"] <= 1.0            # in-window p50 bucket
+        assert hs["p99"] == 10.0                  # overflow clamps to top
+
+    def test_counter_reset_clamps_not_negative(self):
+        from slate_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("t_rst").inc(5.0)
+        sampler = obs.TimeSeriesSampler(registry=reg, interval_s=1.0)
+        sampler.sample(now=0.0)
+        reg.reset()
+        reg.counter("t_rst").inc(2.0)             # restarted from zero
+        w = sampler.sample(now=1.0)
+        deltas = [c["delta"] for c in w["counters"]
+                  if c["name"] == "t_rst"]
+        assert all(d >= 0 for d in deltas)        # never a negative rate
+
+    def test_gauge_carries_latest_value(self):
+        g = obs.gauge("t_ts_g")
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        sampler.sample(now=0.0)
+        g.set(3.0, mesh="2x4")
+        g.set(7.0, mesh="2x4")
+        w = sampler.sample(now=1.0)
+        (gs,) = [e for e in w["gauges"] if e["name"] == "t_ts_g"]
+        assert gs["value"] == 7.0
+
+    def test_export_and_validate_roundtrip(self, tmp_path):
+        sampler = obs.TimeSeriesSampler(interval_s=0.5)
+        sampler.sample(now=0.0)
+        obs.counter("t_exp").inc()
+        sampler.sample(now=1.0)
+        path = sampler.export(str(tmp_path / "ts.json"), source="test",
+                              slos=[{"name": "x", "kind": "error_rate",
+                                     "verdict": "ok", "burn_rate": 0.0}])
+        doc = json.loads((tmp_path / "ts.json").read_text())
+        obs.validate_timeseries(doc)
+        assert doc["schema"] == "slate_tpu.timeseries/v1"
+        assert path.endswith("ts.json")
+
+    def test_validate_rejects_malformed(self):
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        sampler.sample(now=0.0)
+        obs.counter("t_val").inc()
+        sampler.sample(now=1.0)
+        good = sampler.collect(source="x", slos=[
+            {"name": "s", "kind": "latency", "verdict": "ok",
+             "burn_rate": 0.1}])
+        obs.validate_timeseries(good)
+        for mutate in (
+                lambda d: d.update(schema="nope"),
+                lambda d: d.update(interval_s=0),
+                lambda d: d.update(windows="not-a-list"),
+                lambda d: d["windows"][0].update(duration_s=0),
+                lambda d: d["windows"][0]["counters"][0].pop("rate"),
+                lambda d: d["slos"][0].update(verdict="fine"),
+        ):
+            doc = json.loads(json.dumps(good))
+            mutate(doc)
+            with pytest.raises(ValueError):
+                obs.validate_timeseries(doc)
+
+    def test_background_thread_samples(self):
+        sampler = obs.TimeSeriesSampler(interval_s=0.05)
+        with sampler:
+            obs.counter("t_bg").inc(3.0)
+            import time as _time
+
+            _time.sleep(0.2)
+        assert sampler.windows()                  # the thread ticked
+        assert any(c["name"] == "t_bg"
+                   for w in sampler.windows() for c in w["counters"])
+
+
+class TestSLO:
+    @staticmethod
+    def _feed(sampler, t, reqs=0.0, errs=0.0):
+        if reqs:
+            obs.counter("slate_serve_requests_total").inc(reqs, routine="r")
+        if errs:
+            obs.counter("slate_serve_worker_errors_total").inc(
+                errs, routine="r")
+        sampler.sample(now=t)
+
+    def test_error_rate_burn_verdict_transitions(self):
+        """The acceptance bullet: ok -> warning -> breach as the windowed
+        error fraction crosses 1x and 2x the budget."""
+        slo = obs.SLO(name="err", kind="error_rate",
+                      metric="slate_serve_worker_errors_total",
+                      total_metric="slate_serve_requests_total",
+                      objective=0.01, windows=1)
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        mon = obs.SLOMonitor([slo], sampler)
+        sampler.sample(now=0.0)
+        self._feed(sampler, 1.0, reqs=1000, errs=5)     # 0.5% of 1% budget
+        (v,) = mon.evaluate()
+        assert v.verdict == "ok" and v.burn_rate == pytest.approx(0.5)
+        self._feed(sampler, 2.0, reqs=1000, errs=15)    # 1.5% -> burn 1.5
+        (v,) = mon.evaluate()
+        assert v.verdict == "warning"
+        self._feed(sampler, 3.0, reqs=1000, errs=50)    # 5% -> burn 5
+        (v,) = mon.evaluate()
+        assert v.verdict == "breach" and v.burn_rate == pytest.approx(5.0)
+        # and the gauges carry the code the queue reads
+        g = obs.REGISTRY.get("slate_slo_status")
+        assert g.value(slo="err") == 2.0
+
+    def test_latency_slo_ok_and_breach(self):
+        h = obs.histogram("t_slo_lat", buckets=(0.01, 0.1, 1.0))
+        slo = obs.SLO(name="lat", kind="latency", metric="t_slo_lat",
+                      objective=0.1, target=0.9, windows=5)
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        mon = obs.SLOMonitor([slo], sampler)
+        sampler.sample(now=0.0)
+        for _ in range(99):
+            h.observe(0.005)
+        h.observe(0.5)                      # 1% over the bound, 10% budget
+        sampler.sample(now=1.0)
+        (v,) = mon.evaluate()
+        assert v.verdict == "ok" and v.value <= 0.1
+        for _ in range(30):                 # now ~24% over the bound
+            h.observe(0.5)
+        sampler.sample(now=2.0)
+        (v,) = mon.evaluate()
+        assert v.verdict == "breach"
+
+    def test_hit_rate_warmup_windows_exempt(self):
+        slo = obs.SLO(name="hit", kind="hit_rate",
+                      metric="slate_serve_cache_hits_total",
+                      total_metric="slate_serve_cache_misses_total",
+                      objective=0.9, windows=10, warmup_windows=1)
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        mon = obs.SLOMonitor([slo], sampler)
+        sampler.sample(now=0.0)
+        # window 0: all compiles (pure misses) — exempt as warm-up
+        obs.counter("slate_serve_cache_misses_total").inc(50, routine="r")
+        sampler.sample(now=1.0)
+        (v,) = mon.evaluate()
+        assert v.verdict == "no_data"       # nothing after the warm-up yet
+        obs.counter("slate_serve_cache_hits_total").inc(100, routine="r")
+        sampler.sample(now=2.0)
+        (v,) = mon.evaluate()
+        assert v.verdict == "ok" and v.value == pytest.approx(1.0)
+
+    def test_no_data_and_declaration_errors(self):
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        slo = obs.SLO(name="q", kind="latency", metric="absent",
+                      objective=1.0)
+        (v,) = obs.SLOMonitor([slo], sampler).evaluate()
+        assert v.verdict == "no_data" and v.burn_rate is None
+        assert obs.REGISTRY.get("slate_slo_status").value(slo="q") == -1.0
+        with pytest.raises(ValueError):
+            obs.SLO(name="bad", kind="nope", metric="m", objective=1.0)
+        with pytest.raises(ValueError):
+            obs.SLO(name="bad", kind="error_rate", metric="m",
+                    objective=0.1)          # total_metric missing
+        with pytest.raises(ValueError):
+            obs.SLO(name="bad", kind="latency", metric="m", objective=0.1,
+                    target=2.0)
+
+    def test_default_serve_slos_cover_the_roadmap_signals(self):
+        slos = obs.default_serve_slos()
+        kinds = {s.kind for s in slos}
+        assert kinds == {"latency", "error_rate", "hit_rate"}
+        assert {s.name for s in slos} >= {"gesv_p99_latency",
+                                          "serve_error_rate",
+                                          "serve_cache_hit_rate"}
+
+
+class TestFlightRecorder:
+    def test_ring_eviction_and_dump(self, tmp_path):
+        from slate_tpu.serve import FlightRecord, FlightRecorder, \
+            validate_flight
+
+        rec = FlightRecorder(capacity=3)
+        for i in range(5):
+            rec.record(FlightRecord(
+                trace_id=f"t-{i}", routine="gesv", bucket="16x16x2",
+                dtype="float32", t_submit_unix=1000.0 + i,
+                stages={"execute": 0.01 * i}))
+        assert len(rec) == 3
+        assert [r.trace_id for r in rec.records()] == ["t-2", "t-3", "t-4"]
+        path = rec.dump(str(tmp_path / "fl.json"))
+        doc = json.loads((tmp_path / "fl.json").read_text())
+        validate_flight(doc)
+        assert doc["schema"] == "slate_tpu.flight/v1"
+        assert len(doc["records"]) == 3
+        assert rec.dumps == 1 and path.endswith("fl.json")
+
+    def test_validate_flight_rejects_malformed(self):
+        from slate_tpu.serve import validate_flight
+
+        with pytest.raises(ValueError):
+            validate_flight({"schema": "nope", "records": []})
+        with pytest.raises(ValueError):
+            validate_flight({"schema": "slate_tpu.flight/v1",
+                             "records": [{"trace_id": 7}]})
+
+    def test_queue_records_every_request(self, tmp_path):
+        from slate_tpu import serve
+
+        flight = serve.FlightRecorder(
+            auto_dump_path=str(tmp_path / "auto.json"))
+        reqs = serve.make_requests(12, seed=4, dims=(8, 13))
+        serve.solve_many(reqs, flight=flight)
+        recs = flight.records()
+        assert len(recs) == 12
+        r = recs[0]
+        assert r.info == 0 and not r.exhausted and r.error is None
+        assert r.cache_hit in (True, False)
+        assert {"queue_wait", "pad", "cache", "execute"} <= set(r.stages)
+        assert 0.0 < r.occupancy <= 1.0
+        assert not (tmp_path / "auto.json").exists()   # no failure, no dump
+
+    def test_dump_on_ladder_exhaustion(self, tmp_path):
+        """The postmortem contract: a request that exhausts its escalation
+        ladder (singular system — the elementwise re-run fails too)
+        triggers an automatic flight dump."""
+        from slate_tpu import serve
+
+        flight = serve.FlightRecorder(
+            auto_dump_path=str(tmp_path / "auto.json"))
+        n = 8
+        a = np.asarray(np.eye(n), dtype=np.float32)
+        a[3, 3] = 0.0                                  # exactly singular
+        b = np.ones((n, 1), np.float32)
+        (x, info), = serve.solve_many([("gesv", a, b)], flight=flight)
+        assert info != 0
+        assert (tmp_path / "auto.json").exists()
+        doc = json.loads((tmp_path / "auto.json").read_text())
+        serve.validate_flight(doc)
+        assert doc["reason"] == "ladder_exhausted"
+        (rec,) = [r for r in doc["records"] if r["exhausted"]]
+        assert rec["ladder"] == ["batched", "elementwise"]
+        assert rec["info"] != 0
+        # the engine's exhaustion counter fired too (robust/ satellite)
+        ex = obs.REGISTRY.get("slate_robust_ladder_exhausted_total")
+        assert ex is not None and sum(ex.series().values()) >= 1
+
+
+class TestRequestTracing:
+    def test_ticket_spans_stitch_by_trace_id(self, tmp_path):
+        """Acceptance: one ticket's spans — submit, queue-wait, cache,
+        execute, resolve — all carry its trace id, end to end, and two
+        tickets never share one."""
+        from slate_tpu import serve
+        from slate_tpu.utils import trace
+
+        trace.on()
+        try:
+            q = serve.ServeQueue()
+            rng = np.random.default_rng(0)
+            tickets = []
+            for i in range(4):
+                n = (8, 13)[i % 2]
+                a = rng.standard_normal((n, n)).astype(np.float32) \
+                    + n * np.eye(n, dtype=np.float32)
+                tickets.append(q.submit("gesv", a,
+                                        np.ones((n, 1), np.float32)))
+            for t in tickets:
+                _, info = t.result(timeout=120)
+                assert info == 0
+            q.close()
+            path = trace.finish(str(tmp_path / "trace.json"))
+            events = json.load(open(path))["traceEvents"]
+        finally:
+            trace.off()
+            trace.finish(str(tmp_path / "drain.json"))
+        by_id = {}
+        for e in events:
+            tid = e.get("args", {}).get("trace_id")
+            if tid is not None:
+                by_id.setdefault(tid, set()).add(e["name"])
+        ids = [t.trace_id for t in tickets]
+        assert len(set(ids)) == len(ids)               # unique per request
+        for t in tickets:
+            assert {"serve.submit", "serve.queue_wait", "serve.pad",
+                    "serve.cache", "serve.execute",
+                    "serve.resolve"} <= by_id[t.trace_id], \
+                f"unstitchable lifeline for {t.trace_id}"
+            assert {"queue_wait", "pad", "cache", "execute",
+                    "resolve", "submit"} <= set(t.stages)
+            assert all(v >= 0 for v in t.stages.values())
+
+    def test_ladder_events_carry_the_requests_trace_id(self, tmp_path):
+        """robust/ integration: the fallback + exhaustion events of a failing
+        request appear in the timeline under ITS trace id (stitched through
+        the batch worker and the per-element escalation)."""
+        from slate_tpu import serve
+        from slate_tpu.utils import trace
+
+        n = 8
+        a = np.asarray(np.eye(n), dtype=np.float32)
+        a[2, 2] = 0.0
+        b = np.ones((n, 1), np.float32)
+        trace.on()
+        try:
+            q = serve.ServeQueue(flight=serve.FlightRecorder(
+                auto_dump_path=str(tmp_path / "auto.json")))
+            t = q.submit("gesv", a, b)
+            _, info = t.result(timeout=120)
+            assert info != 0
+            q.close()
+            path = trace.finish(str(tmp_path / "trace.json"))
+            events = json.load(open(path))["traceEvents"]
+        finally:
+            trace.off()
+            trace.finish(str(tmp_path / "drain.json"))
+        mine = [e for e in events
+                if e.get("args", {}).get("trace_id") == t.trace_id]
+        names = {e["name"] for e in mine}
+        assert "fallback" in names, "escalation not stitched to the request"
+        assert "ladder_exhausted" in names
+        assert t.exhausted and t.ladder == ("batched", "elementwise")
+
+    def test_device_sync_scope_blocks_and_labels(self):
+        """Satellite: a device_sync scope's duration includes materializing
+        the result, and the series is labeled so synced/unsynced never
+        mix."""
+        with obs.scope("sync_span", device_sync=True) as sp:
+            sp.set_result(jnp.ones((256, 256)) @ jnp.ones((256, 256)))
+        c = obs.REGISTRY.get("slate_spans_total")
+        assert c.value(routine="sync_span", device_sync="true") == 1.0
+        h = obs.REGISTRY.get("slate_span_seconds")
+        assert h.snapshot(routine="sync_span",
+                          device_sync="true")["count"] == 1
+
+
 class TestScalingRegistry:
     def test_specs_cover_every_parallel_module(self):
         """SCALING.md's coverage claim: at least one audited routine per

@@ -356,6 +356,67 @@ class TestServeQueue:
         assert stats["bad"] == 0
         assert stats["misses_after_warmup"] == 0
 
+    def test_stage_histograms_split_the_latency(self):
+        """Satellite: queue wait, execute, and pad are separately visible —
+        not folded into one submit-to-result histogram."""
+        from slate_tpu import obs
+
+        reqs = serve.make_requests(12, seed=9, dims=(8, 13))
+        serve.solve_many(reqs)
+        for name in ("slate_serve_queue_wait_seconds",
+                     "slate_serve_execute_seconds",
+                     "slate_serve_pad_seconds"):
+            h = obs.REGISTRY.get(name)
+            assert h is not None and h.series(), f"{name} not recorded"
+        # queue-wait is per request; execute/pad are per batch
+        qw = obs.REGISTRY.get("slate_serve_queue_wait_seconds")
+        total = sum(s["count"] for s in qw.series().values())
+        assert total >= 12
+
+    def test_worker_error_surfaces_in_registry_and_flight(self, monkeypatch):
+        """Satellite: a worker-thread exception is a labeled counter, a
+        trace event, and a flight record — not only the losing ticket's
+        re-raise."""
+        from slate_tpu import obs
+        from slate_tpu.serve import queue as queue_mod
+
+        def boom(A, B, opts=None, cache=None, donate=False):
+            raise RuntimeError("injected worker failure")
+
+        monkeypatch.setitem(queue_mod.DRIVERS, "gesv", boom)
+        flight = serve.FlightRecorder(auto_dump_path="/dev/null")
+        q = serve.ServeQueue(flight=flight)
+        t = q.submit("gesv", _dd(8, np.float32), _randn(8, 1, np.float32))
+        with pytest.raises(RuntimeError, match="injected worker failure"):
+            t.result(timeout=60)
+        q.close()
+        c = obs.REGISTRY.get("slate_serve_worker_errors_total")
+        assert c is not None
+        assert c.value(routine="gesv", bucket="16x16x1",
+                       error="RuntimeError") == 1.0
+        (rec,) = [r for r in flight.records() if r.error]
+        assert "injected worker failure" in rec.error
+        assert rec.trace_id == t.trace_id
+
+    def test_slo_status_readable_from_queue(self):
+        from slate_tpu import obs
+
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        sampler.sample(now=0.0)
+        obs.counter("slate_serve_requests_total").inc(100, routine="gesv")
+        sampler.sample(now=1.0)
+        mon = obs.SLOMonitor([obs.SLO(
+            name="t_err", kind="error_rate",
+            metric="slate_serve_worker_errors_total",
+            total_metric="slate_serve_requests_total",
+            objective=0.01)], sampler)
+        q = serve.ServeQueue(start=False)
+        q.attach_slo(mon)
+        (v,) = q.slo_verdicts()
+        assert v.verdict == "ok"
+        assert q.slo_status().get("t_err") == 0
+        q.close()
+
 
 # ---------------------------------------------------------------------------
 # batch-sharded parallel entry

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Render OBS_REPORT.md from the exported telemetry artifacts.
+
+Inputs (all JSON documents written by the obs layer):
+
+* ``metrics_timeseries.json`` — the window ring + SLO verdicts
+  (schema ``slate_tpu.timeseries/v1``, :mod:`slate_tpu.obs.timeseries`);
+* ``metrics.json`` — the cumulative registry document (schema
+  ``slate_tpu.metrics/v1``), source of the per-routine stage-latency
+  decomposition;
+* optionally a flight-recorder dump (schema ``slate_tpu.flight/v1``).
+
+Output: one markdown report — per-routine stage-latency decomposition
+(queue-wait vs execute vs pad, p50/p99 from the histogram buckets), window
+request/batch/error rates, the SLO verdict table, and the flight-recorder
+summary.  The CI serving-smoke step writes it next to the artifacts it
+renders; ``render_report`` is importable so the smoke gates on the same
+numbers it publishes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _load(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    if not path:
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _hist_samples(metrics_doc: Dict[str, Any], name: str
+                  ) -> List[Dict[str, Any]]:
+    for m in metrics_doc.get("metrics", ()):
+        if m["name"] == name and m["kind"] == "histogram":
+            return m["samples"]
+    return []
+
+
+def _merge_counts(samples: List[Dict[str, Any]],
+                  routine: Optional[str] = None
+                  ) -> Optional[Tuple[List[float], List[float]]]:
+    """Sum histogram counts across samples (optionally filtered to one
+    routine label via ``routine`` or ``driver``); None when nothing
+    matches."""
+    buckets: Optional[List[float]] = None
+    counts: Optional[List[float]] = None
+    for s in samples:
+        lab = s.get("labels", {})
+        if routine is not None and routine not in (lab.get("routine"),
+                                                   lab.get("driver")):
+            continue
+        if buckets is None:
+            buckets, counts = list(s["buckets"]), [0.0] * len(s["counts"])
+        if list(s["buckets"]) != buckets:
+            continue                 # mixed bucket tables never merge
+        counts = [a + b for a, b in zip(counts, s["counts"])]
+    if counts is None or sum(counts) <= 0:
+        return None
+    return buckets, counts
+
+
+def _pcts(merged) -> str:
+    from slate_tpu.obs import quantile_from_counts
+
+    if merged is None:
+        return "—"
+    buckets, counts = merged
+    p50 = quantile_from_counts(buckets, counts, 0.50)
+    p99 = quantile_from_counts(buckets, counts, 0.99)
+    return f"{p50 * 1e3:.2f} / {p99 * 1e3:.2f}"
+
+
+#: stage -> histogram family (the decomposition's columns)
+STAGE_HISTS = (
+    ("queue-wait", "slate_serve_queue_wait_seconds"),
+    ("pad", "slate_serve_pad_seconds"),
+    ("execute", "slate_serve_execute_seconds"),
+    ("total", "slate_serve_latency_seconds"),
+)
+
+
+def _stage_table(metrics_doc: Dict[str, Any]) -> List[str]:
+    routines = sorted({
+        s["labels"].get("routine", s["labels"].get("driver", "?"))
+        for s in _hist_samples(metrics_doc, "slate_serve_latency_seconds")})
+    if not routines:
+        return ["_no serving traffic recorded_", ""]
+    lines = ["| routine | " + " | ".join(
+        f"{name} p50/p99 (ms)" for name, _ in STAGE_HISTS) + " |",
+        "|---|" + "---|" * len(STAGE_HISTS)]
+    for r in routines:
+        cells = []
+        for _, hist in STAGE_HISTS:
+            samples = _hist_samples(metrics_doc, hist)
+            cells.append(_pcts(_merge_counts(samples, routine=r)))
+        lines.append(f"| `{r}` | " + " | ".join(cells) + " |")
+    lines.append("")
+    lines.append("(execute = device time with the cache share subtracted "
+                 "and the result blocked on; batch-level stages are "
+                 "attributed to every request in the batch)")
+    return lines + [""]
+
+
+def _rate(window: Dict[str, Any], counter: str) -> float:
+    return sum(c["rate"] for c in window["counters"]
+               if c["name"] == counter)
+
+
+def _window_table(ts_doc: Dict[str, Any], max_rows: int = 30) -> List[str]:
+    ws = ts_doc.get("windows", [])
+    if not ws:
+        return ["_no windows sampled_", ""]
+    t0 = ws[0]["t_start"]
+    lines = ["| window | t+ (s) | dur (s) | req/s | batch/s | err/s | "
+             "p99 lat (ms) |", "|---|---|---|---|---|---|---|"]
+    shown = ws[-max_rows:]
+    for w in shown:
+        p99 = None
+        merged = _merge_counts(
+            [h for h in w["histograms"]
+             if h["name"] == "slate_serve_latency_seconds"])
+        if merged is not None:
+            from slate_tpu.obs import quantile_from_counts
+
+            p99 = quantile_from_counts(*merged, 0.99)
+        p99_cell = f"{p99 * 1e3:.2f}" if p99 is not None else "—"
+        lines.append(
+            f"| {w['index']} | {w['t_start'] - t0:.2f} "
+            f"| {w['duration_s']:.2f} "
+            f"| {_rate(w, 'slate_serve_requests_total'):.1f} "
+            f"| {_rate(w, 'slate_serve_batches_total'):.1f} "
+            f"| {_rate(w, 'slate_serve_worker_errors_total'):.2f} "
+            f"| {p99_cell} |")
+    if len(ws) > max_rows:
+        lines.append(f"| … | | | | | | ({len(ws) - max_rows} older windows "
+                     "elided) |")
+    return lines + [""]
+
+
+_VERDICT_MARK = {"ok": "✅ ok", "warning": "⚠️ warning", "breach": "❌ breach",
+                 "no_data": "∅ no data"}
+
+
+def _slo_table(ts_doc: Dict[str, Any]) -> List[str]:
+    slos = ts_doc.get("slos")
+    if not slos:
+        return ["_no SLOs evaluated_", ""]
+    lines = ["| SLO | kind | verdict | burn rate | detail |",
+             "|---|---|---|---|---|"]
+    for v in slos:
+        burn = v.get("burn_rate")
+        burn_cell = f"{burn:.2f}" if burn is not None else "—"
+        lines.append(
+            f"| `{v['name']}` | {v.get('kind', '?')} "
+            f"| {_VERDICT_MARK.get(v['verdict'], v['verdict'])} "
+            f"| {burn_cell} | {v.get('detail', '')} |")
+    return lines + [""]
+
+
+def _flight_section(flight_doc: Optional[Dict[str, Any]]) -> List[str]:
+    if flight_doc is None:
+        return ["_no flight-recorder dump supplied_", ""]
+    recs = flight_doc.get("records", [])
+    exhausted = [r for r in recs if r.get("exhausted")]
+    errors = [r for r in recs if r.get("error")]
+    lines = [f"{len(recs)} records in the ring "
+             f"(capacity {flight_doc.get('capacity', '?')}, dump reason "
+             f"`{flight_doc.get('reason', '?')}`): "
+             f"{len(exhausted)} ladder-exhausted, "
+             f"{len(errors)} worker-error.", ""]
+    for r in (exhausted or errors)[-3:]:
+        stages = ", ".join(f"{k}={v * 1e3:.2f}ms"
+                           for k, v in r.get("stages", {}).items())
+        lines.append(f"* `{r['trace_id']}` {r['routine']}@{r['bucket']} "
+                     f"info={r.get('info')} ladder={r.get('ladder')} "
+                     f"error={r.get('error')} — {stages}")
+    if exhausted or errors:
+        lines.append("")
+    return lines
+
+
+def render_report(ts_doc: Dict[str, Any],
+                  metrics_doc: Optional[Dict[str, Any]] = None,
+                  flight_doc: Optional[Dict[str, Any]] = None) -> str:
+    ws = ts_doc.get("windows", [])
+    span = (ws[-1]["t_end"] - ws[0]["t_start"]) if ws else 0.0
+    md = [
+        "# OBS_REPORT — serving telemetry",
+        "",
+        f"Source `{ts_doc.get('source', '?')}` · {len(ws)} windows over "
+        f"{span:.2f}s (interval {ts_doc.get('interval_s', '?')}s) · "
+        "generated by `tools/obs_report.py` from "
+        "`metrics_timeseries.json` (+ `metrics.json`, flight dump).",
+        "",
+        "## SLO verdicts",
+        "",
+        *_slo_table(ts_doc),
+        "## Per-routine stage-latency decomposition",
+        "",
+    ]
+    if metrics_doc is not None:
+        md += _stage_table(metrics_doc)
+    else:
+        md += ["_no metrics.json supplied_", ""]
+    md += ["## Window rates", "", *_window_table(ts_doc),
+           "## Flight recorder", "", *_flight_section(flight_doc)]
+    return "\n".join(md).rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--timeseries", default="metrics_timeseries.json",
+                    help="metrics_timeseries.json path")
+    ap.add_argument("--metrics", default=None, help="metrics.json path")
+    ap.add_argument("--flight", default=None, help="flight dump path")
+    ap.add_argument("--out", default="OBS_REPORT.md", help="output path")
+    args = ap.parse_args(argv)
+
+    from slate_tpu.obs import validate_timeseries
+
+    ts_doc = _load(args.timeseries)
+    validate_timeseries(ts_doc)
+    report = render_report(ts_doc, _load(args.metrics), _load(args.flight))
+    with open(args.out, "w") as f:
+        f.write(report)
+    print(f"wrote {args.out}: {len(ts_doc.get('windows', []))} windows, "
+          f"{len(ts_doc.get('slos') or [])} SLO verdicts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""CI serving-smoke: a short mixed workload through the serving queue on CPU.
+"""CI serving-smoke: a short mixed workload through the serving queue on CPU,
+run under the runtime-telemetry tier.
 
 Gates (the ci.yml ``serving-smoke`` step fails on any):
 
@@ -8,9 +9,20 @@ Gates (the ci.yml ``serving-smoke`` step fails on any):
 * ZERO executable-cache misses after warm-up (the compile-count property —
   a silent recompile in the serving path fails CI here in CPU seconds),
 * the run's metrics.json validates against the shared schema and carries
-  the serving counters (requests, batches, occupancy, cache hits).
+  the serving counters (requests, batches, occupancy, cache hits) AND the
+  stage histograms (queue-wait / execute / pad),
+* the sampler's ``metrics_timeseries.json`` validates against
+  ``slate_tpu.timeseries/v1`` and carries >= 2 traffic windows,
+* every declared serve SLO evaluates to an EXPLICIT verdict (ok / warning /
+  breach — ``no_data`` on a routine that served traffic fails), and none
+  reads ``breach``,
+* every sampled request's spans are stitchable from the chrome-trace by its
+  ticket's trace id (submit, queue-wait, execute, resolve at minimum).
 
-Prints one JSON line with the numbers so the CI log doubles as a record.
+Artifacts written for CI upload: ``metrics_timeseries.json``,
+``OBS_REPORT.md``, ``serving_metrics.json``, ``serving_trace.json``,
+``flight_records.json``.  Prints one JSON line with the numbers so the CI
+log doubles as a record.
 """
 
 from __future__ import annotations
@@ -27,25 +39,61 @@ from force_cpu import force_cpu_backend  # noqa: E402
 
 force_cpu_backend()
 
+NUM_REQUESTS = 300
+STITCH_SAMPLE = 8          # tickets spot-checked for trace stitchability
+REQUIRED_STAGES = {"serve.submit", "serve.queue_wait", "serve.execute",
+                   "serve.resolve"}
+
 
 def main() -> int:
     from slate_tpu import obs, serve
     from slate_tpu.serve.queue import BucketPolicy
+    from slate_tpu.utils import trace
+
+    import obs_report
 
     # compact policy: enough bucket diversity to exercise mixed packing,
     # small enough that warm-up stays in CI seconds
     policy = BucketPolicy(dims=(16, 32, 64), nrhs_dims=(2,),
                           batch_dims=(1, 8, 32), max_batch=32,
                           max_wait_ms=5.0)
+    flight = serve.FlightRecorder(auto_dump_path="flight_records.json")
+    sampler = obs.TimeSeriesSampler(interval_s=0.25)
+    # the smoke submits all requests in one burst, so submit-to-result
+    # latency is dominated by standing in line behind the whole backlog —
+    # the latency objective is sized for that burst (plus slow CI runners),
+    # not for steady-state serving
+    monitor = obs.SLOMonitor(
+        obs.default_serve_slos(p99_latency_s=30.0, warmup_windows=0,
+                               windows=10_000), sampler)
+
+    def after_warmup(q):
+        # telemetry tier, armed between warm-up and the measured pass: the
+        # sampler baseline lands AFTER warm-up (so the hit-rate SLO sees
+        # steady-state traffic, not the warm-up compiles) and tracing turns
+        # on so the stage spans land in the chrome-trace
+        trace.on()
+        sampler.start()
+        q.attach_slo(monitor)
+
     stats = serve.run_mixed_workload(
-        num_requests=300, seed=0, policy=policy,
-        dims=(8, 13, 24, 40, 60), use_queue=True, warm=True, check=True)
+        num_requests=NUM_REQUESTS, seed=0, policy=policy,
+        dims=(8, 13, 24, 40, 60), use_queue=True, warm=True, check=False,
+        flight=flight, return_tickets=True, after_warmup=after_warmup)
+    tickets = stats["tickets"]
+    sampler.stop()          # takes the final window
+    trace_path = trace.finish("serving_trace.json")
+    trace.off()
 
     failures = []
+    if stats["bad"]:
+        failures.append(f"{stats['bad']}/{stats['requests']} requests "
+                        "returned nonzero info or non-finite results")
+    p50_ms, p99_ms = stats["p50_ms"], stats["p99_ms"]
+    if p50_ms is None or p99_ms is None:
+        failures.append("p50/p99 latency not recorded")
     if not stats["solves_per_sec"] > 0:
         failures.append(f"solves/sec not positive: {stats['solves_per_sec']}")
-    if stats["p50_ms"] is None or stats["p99_ms"] is None:
-        failures.append("p50/p99 latency not recorded")
     if stats["misses_after_warmup"] != 0:
         failures.append(f"{stats['misses_after_warmup']} cache misses after "
                         "warm-up (silent recompiles in the serving path)")
@@ -53,6 +101,7 @@ def main() -> int:
         failures.append(f"only {stats['distinct_buckets']} shape buckets "
                         "exercised (need >= 4)")
 
+    # -- metrics.json: schema + serving counters + stage histograms ---------
     doc = obs.metrics_doc(source="serving-smoke")
     try:
         obs.validate_metrics(doc)
@@ -62,19 +111,97 @@ def main() -> int:
     for need in ("slate_serve_requests_total", "slate_serve_batches_total",
                  "slate_serve_batch_occupancy",
                  "slate_serve_cache_hits_total",
-                 "slate_serve_latency_seconds"):
+                 "slate_serve_latency_seconds",
+                 "slate_serve_queue_wait_seconds",
+                 "slate_serve_execute_seconds",
+                 "slate_serve_pad_seconds"):
         if need not in names:
             failures.append(f"metric {need} missing from the registry")
+    obs.export_metrics("serving_metrics.json", source="serving-smoke")
+
+    # -- timeseries + SLO verdicts ------------------------------------------
+    verdicts = monitor.evaluate()
+    ts_path = sampler.export("metrics_timeseries.json",
+                             source="serving-smoke",
+                             slos=[v.to_dict() for v in verdicts])
+    ts_doc = json.load(open(ts_path))
+    try:
+        obs.validate_timeseries(ts_doc)
+    except ValueError as e:
+        failures.append(f"metrics_timeseries.json schema violation: {e}")
+    # >= 1 is deterministic (all served traffic lands in SOME window's
+    # deltas); >= 2 would flake whenever a fast runner drains the warm
+    # workload inside one sampler tick.  Multi-window rate math is pinned
+    # by tests/test_obs.py with explicit timestamps instead.
+    traffic_windows = [
+        w for w in ts_doc["windows"]
+        if any(e["name"].startswith("slate_serve_")
+               for e in w["counters"] + w["histograms"])]
+    if not traffic_windows:
+        failures.append("no sampled window carries serving traffic")
+    served = set(stats["routines"])
+    for v in verdicts:
+        routine = v.name.split("_")[0]
+        has_traffic = v.kind != "latency" or routine in served
+        if has_traffic and v.verdict == "no_data":
+            failures.append(f"SLO {v.name}: no verdict despite traffic")
+        if v.verdict == "breach":
+            failures.append(f"SLO {v.name}: BREACH ({v.detail})")
+    if not verdicts:
+        failures.append("no SLO verdicts evaluated")
+
+    # -- trace stitchability ------------------------------------------------
+    stitched = 0
+    if trace_path is None:
+        failures.append("no chrome-trace written")
+    else:
+        events = json.load(open(trace_path))["traceEvents"]
+        by_id = {}
+        for e in events:
+            tid = e.get("args", {}).get("trace_id")
+            if tid is not None:
+                by_id.setdefault(tid, set()).add(e["name"])
+        step = max(len(tickets) // STITCH_SAMPLE, 1)
+        sample = tickets[::step][:STITCH_SAMPLE]
+        for t in sample:
+            have = by_id.get(t.trace_id, set())
+            if REQUIRED_STAGES <= have:
+                stitched += 1
+            else:
+                failures.append(
+                    f"ticket {t.trace_id}: spans not stitchable "
+                    f"(missing {sorted(REQUIRED_STAGES - have)})")
+
+    # -- flight recorder + report -------------------------------------------
+    flight_path = flight.dump("flight_records.json")
+    if len(flight.records()) < min(NUM_REQUESTS, flight.capacity):
+        failures.append(f"flight recorder holds {len(flight.records())} "
+                        "records, expected one per served request")
+    report = obs_report.render_report(ts_doc, doc,
+                                      json.load(open(flight_path)))
+    with open("OBS_REPORT.md", "w") as f:
+        f.write(report)
+    for need in ("## SLO verdicts", "## Per-routine stage-latency",
+                 "queue-wait p50/p99"):
+        if need not in report:
+            failures.append(f"OBS_REPORT.md missing section: {need!r}")
 
     print(json.dumps({
         "ok": not failures,
         "solves_per_sec": stats["solves_per_sec"],
-        "p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"],
+        "p50_ms": p50_ms, "p99_ms": p99_ms,
         "requests": stats["requests"],
         "distinct_buckets": stats["distinct_buckets"],
         "cache": stats["cache"],
         "misses_after_warmup": stats["misses_after_warmup"],
         "warmup_s": (stats["warmup"] or {}).get("seconds"),
+        "windows": len(ts_doc["windows"]),
+        "slo": {v.name: v.verdict for v in verdicts},
+        "stitched_tickets": stitched,
+        "flight_records": len(flight.records()),
+        "artifacts": ["metrics_timeseries.json", "OBS_REPORT.md",
+                      "serving_metrics.json", "serving_trace.json",
+                      "flight_records.json"],
         "failures": failures,
     }))
     return 1 if failures else 0
