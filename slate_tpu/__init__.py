@@ -13,14 +13,15 @@ verb-style convenience layer mirroring ``include/slate/simplified_api.hh`` lives
 :mod:`slate_tpu.simplified`.
 """
 
-from .core import (BandMatrix, BaseMatrix, ConvergenceError, Diag, GridOrder,
+from .core import (BandMatrix, BaseMatrix, ConvergenceError,
+                   DeadlineExceededError, Diag, GridOrder,
                    HermitianBandMatrix, HermitianMatrix, Layout, Matrix,
                    MethodCholQR, MethodEig, MethodGels, MethodGemm, MethodHemm,
                    MethodLU, MethodSVD, MethodTrsm, Norm, NormScope,
-                   NumericalError, Op, Options, Side, SingularMatrixError,
-                   SlateError, SymmetricMatrix, Target, TileKind,
-                   TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
-                   Uplo, func)
+                   NumericalError, Op, Options, QueueOverloadError, Side,
+                   SingularMatrixError, SlateError, SymmetricMatrix, Target,
+                   TileKind, TrapezoidMatrix, TriangularBandMatrix,
+                   TriangularMatrix, Uplo, func)
 
 from .blas import (add, col_norms, copy, gemm, gemmA, gemmC, hemm, hemmA,
                    hemmC, her2k, herk, norm, scale, scale_row_col, set,

@@ -1,7 +1,8 @@
 """Core runtime: types, tile-grid metadata, matrix hierarchy (reference L2)."""
 
-from .exceptions import (ConvergenceError, NumericalError, SingularMatrixError,
-                         SlateError, slate_assert)
+from .exceptions import (ConvergenceError, DeadlineExceededError,
+                         NumericalError, QueueOverloadError,
+                         SingularMatrixError, SlateError, slate_assert)
 from .types import (Diag, GridOrder, Layout, MethodCholQR, MethodEig, MethodGels,
                     MethodGemm, MethodHemm, MethodLU, MethodSVD, MethodTrsm, Norm,
                     NormScope, Op, Options, Side, Target, TileKind, Uplo)

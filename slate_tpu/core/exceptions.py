@@ -14,6 +14,17 @@ drivers distinguish through info codes and fallback paths (SURVEY §2.7):
   Raised by ``slate_tpu.robust.run_ladder`` when the caller asks for it
   (``raise_on_exhaust=True``); the built-in drivers keep LAPACK semantics
   instead — best-effort result, nonzero info, ``recovered=False`` report.
+
+The serving tier (``slate_tpu.serve``) adds two *operational* failure
+classes — the numbers were fine (or never computed), the service declined
+the work:
+
+- :class:`QueueOverloadError` — admission control rejected the request
+  (lane queue full, token bucket empty, or SLO-coupled shedding active).
+  Carries the lane, the observed queue depth, and a retry-after hint.
+- :class:`DeadlineExceededError` — a queued request's deadline budget ran
+  out before (or while) it would have been served; the queue expires it
+  instead of wasting a batch slot.
 """
 
 from __future__ import annotations
@@ -54,6 +65,49 @@ class ConvergenceError(NumericalError):
     def __init__(self, msg: str = "", report=None):
         super().__init__(msg or "iterative solve failed to converge")
         self.report = report
+
+
+class QueueOverloadError(SlateError):
+    """Admission control rejected the request — the serving tier is shedding.
+
+    Structured fields (the load-balancer / retry-loop contract):
+
+    ``lane``          the priority lane the request targeted;
+    ``depth``         that lane's queue depth at rejection time;
+    ``reason``        what tripped — ``depth`` (lane queue full),
+                      ``inflight`` (global in-flight cap), ``rate`` (token
+                      bucket empty), ``slo_warning`` / ``slo_breach``
+                      (SLO-coupled shedding);
+    ``retry_after_s`` hint for when the caller may retry (None = unknown —
+                      re-probe, don't hammer).
+    """
+
+    def __init__(self, msg: str = "", lane: str = "", depth: int = 0,
+                 reason: str = "", retry_after_s: float = None):
+        super().__init__(
+            msg or f"serve: lane {lane!r} shedding load "
+                   f"(reason={reason or '?'}, depth={depth})")
+        self.lane = str(lane)
+        self.depth = int(depth)
+        self.reason = str(reason)
+        self.retry_after_s = (None if retry_after_s is None
+                              else float(retry_after_s))
+
+
+class DeadlineExceededError(SlateError):
+    """A request's deadline budget expired before it was served.
+
+    ``lane`` / ``deadline_s`` (the submitted budget, seconds) /
+    ``elapsed_s`` (time spent queued when the queue expired it)."""
+
+    def __init__(self, msg: str = "", lane: str = "",
+                 deadline_s: float = 0.0, elapsed_s: float = 0.0):
+        super().__init__(
+            msg or f"serve: deadline of {deadline_s:g}s exceeded after "
+                   f"{elapsed_s:.3f}s queued (lane {lane!r})")
+        self.lane = str(lane)
+        self.deadline_s = float(deadline_s)
+        self.elapsed_s = float(elapsed_s)
 
 
 def slate_assert(cond: bool, msg: str = "") -> None:

@@ -19,14 +19,14 @@ Three parts (see README.md "Failure handling & fault injection"):
 """
 
 from .faults import (FaultPlan, FaultSpec, POINT_FACTOR, POINT_INPUT,
-                     POINT_OUTPUT, active, inject)
+                     POINT_OUTPUT, POINT_SERVE, active, inject, inject_serve)
 from .policy import LADDERS, RetryPolicy, Rung, guard_shards, run_ladder
 from .report import (SolveReport, first_bad_index, first_bad_index_batched,
                      reduce_info)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "POINT_FACTOR", "POINT_INPUT", "POINT_OUTPUT",
-    "active", "inject", "LADDERS", "RetryPolicy", "Rung", "guard_shards",
-    "run_ladder", "SolveReport", "first_bad_index", "first_bad_index_batched",
-    "reduce_info",
+    "POINT_SERVE", "active", "inject", "inject_serve", "LADDERS",
+    "RetryPolicy", "Rung", "guard_shards", "run_ladder", "SolveReport",
+    "first_bad_index", "first_bad_index_batched", "reduce_info",
 ]

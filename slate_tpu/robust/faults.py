@@ -38,6 +38,22 @@ Fault classes (the chaos vocabulary of tests/test_robust.py):
     NaN-fill the rows owned by shard ``index`` of ``world`` at a distributed
     solve's *output* (point="output") — a device dropping out mid-collective;
     the retry guard (robust.policy.guard_shards) detects and re-runs.
+
+Serving-level faults (point="serve" — host-side events at the serving
+queue's batch boundary, not array corruptions; the queue acts on the fired
+spec via :func:`inject_serve`):
+
+``slow_executor``
+    The batch runner sleeps ``delay_s`` seconds before executing — a
+    stalled device / noisy-neighbor executor; exercises deadline expiry and
+    the SLO latency verdicts.
+``worker_crash``
+    The batch runner raises before serving — an unexpected worker-thread
+    death; exercises the queue's fail-queued-tickets-fast path.
+``cache_flush``
+    The executable cache is cleared — a restarted executor losing its
+    compiled programs; exercises the recompile path and the cache hit-rate
+    SLO.
 """
 
 from __future__ import annotations
@@ -70,6 +86,7 @@ def count_event(name: str, **labels) -> None:
 POINT_INPUT = "input"      # operand at driver entry
 POINT_FACTOR = "factor"    # low-precision / intermediate factor
 POINT_OUTPUT = "output"    # solve result (distributed shard failures)
+POINT_SERVE = "serve"      # serving-queue batch boundary (host-side events)
 
 _KIND_POINT = {
     "nan_tile": POINT_INPUT,
@@ -77,6 +94,9 @@ _KIND_POINT = {
     "zero_pivot": POINT_INPUT,
     "ir_stall": POINT_FACTOR,
     "shard_fail": POINT_OUTPUT,
+    "slow_executor": POINT_SERVE,
+    "worker_crash": POINT_SERVE,
+    "cache_flush": POINT_SERVE,
 }
 
 
@@ -99,6 +119,8 @@ class FaultSpec:
     scale:      multiplicative magnitude for ir_stall (≫1 ⇒ the perturbed
                 factor's solve contracts the residual by ~1/scale² per sweep
                 — a guaranteed stall at the default tolerance).
+    delay_s:    stall duration for ``slow_executor`` (exact, deterministic —
+                the chaos clock is the plan, not a RNG).
     """
 
     driver: str
@@ -109,6 +131,7 @@ class FaultSpec:
     index: int = 0
     world: int = 8
     scale: float = 1e3
+    delay_s: float = 0.05
 
     def __post_init__(self):
         if self.kind not in _KIND_POINT:
@@ -243,3 +266,26 @@ def inject(driver: str, x, point: str = POINT_INPUT):
         count_event("slate_robust_faults_injected_total",
                     routine=driver, kind=spec.kind, point=point)
     return x
+
+
+def inject_serve(site: str) -> List[FaultSpec]:
+    """Serving-level injection boundary: which serve faults fire at this
+    (site, call) point of the active plan.
+
+    Unlike :func:`inject` — a pure array→array transform — serving faults
+    are host-side *events* (a stall, a crash, a cache wipe), so this hook
+    returns the fired specs and the serving layer acts on them
+    (``slate_tpu.serve.queue`` sleeps / raises / clears the cache).  Same
+    call accounting as the numerical faults: ``call_index`` counts batch
+    executions at ``site``, so a ``worker_crash`` at call 2 kills the third
+    batch deterministically.  Zero-overhead with no plan active."""
+    plan = active()
+    if plan is None:
+        return []
+    specs = plan._take(site, POINT_SERVE)
+    for spec in specs:
+        trace_event("fault_inject", driver=site, kind=spec.kind,
+                    point=POINT_SERVE, call=spec.call_index)
+        count_event("slate_robust_faults_injected_total",
+                    routine=site, kind=spec.kind, point=POINT_SERVE)
+    return specs

@@ -34,20 +34,29 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..core.exceptions import DeadlineExceededError, QueueOverloadError
+from .admission import (AdmissionController, AdmissionPolicy, DEFAULT_LANE,
+                        EscalationBudget, LANES, TokenBucket,
+                        shed_lanes_from_verdicts)
 from .batched import (gels_batched, gesv_batched, last_escalations,
-                      posv_batched)
+                      posv_batched, set_escalation_gate)
 from .cache import ExecutableCache, default_cache, reset_cache
 from .flight import FlightRecord, FlightRecorder, validate_flight
-from .queue import (BucketPolicy, ServeQueue, Ticket, pad_request,
-                    solve_many, unpad_result)
-from .workload import make_requests, run_mixed_workload
+from .queue import (BucketPolicy, SERVE_SITE, ServeQueue, Ticket,
+                    pad_request, solve_many, unpad_result)
+from .workload import make_requests, run_mixed_workload, run_overload_workload
 
 __all__ = [
     "gesv_batched", "posv_batched", "gels_batched", "last_escalations",
+    "set_escalation_gate",
     "ExecutableCache", "default_cache", "reset_cache",
     "FlightRecord", "FlightRecorder", "validate_flight",
     "BucketPolicy", "ServeQueue", "Ticket", "pad_request", "unpad_result",
     "solve_many", "make_requests", "run_mixed_workload",
+    "run_overload_workload",
+    "AdmissionController", "AdmissionPolicy", "DEFAULT_LANE",
+    "EscalationBudget", "LANES", "TokenBucket", "shed_lanes_from_verdicts",
+    "QueueOverloadError", "DeadlineExceededError", "SERVE_SITE",
     "submit", "default_queue", "shutdown",
 ]
 
@@ -64,10 +73,13 @@ def default_queue() -> ServeQueue:
         return _QUEUE
 
 
-def submit(routine: str, a, b) -> Ticket:
+def submit(routine: str, a, b, lane: str = DEFAULT_LANE,
+           deadline: Optional[float] = None) -> Ticket:
     """Submit one solve to the default queue; returns a :class:`Ticket`
-    (``.result()`` blocks for ``(x, info)``)."""
-    return default_queue().submit(routine, a, b)
+    (``.result()`` blocks for ``(x, info)``).  ``lane`` / ``deadline``
+    follow :meth:`ServeQueue.submit` (priority lane; seconds of budget)."""
+    return default_queue().submit(routine, a, b, lane=lane,
+                                  deadline=deadline)
 
 
 def shutdown() -> None:

@@ -67,9 +67,26 @@ _tl = threading.local()
 
 def last_escalations() -> Dict[int, Dict[str, Any]]:
     """``{batch element: {"rungs": (...), "recovered": bool}}`` for the most
-    recent batched driver call on this thread ({} when nothing escalated)."""
+    recent batched driver call on this thread ({} when nothing escalated);
+    budget-capped elements additionally carry ``"capped": True``."""
     return {k: dict(v) for k, v in
             (getattr(_tl, "escalations", None) or {}).items()}
+
+
+def set_escalation_gate(gate: Optional[Callable[[int], int]]):
+    """Install this thread's escalation budget; returns the previous gate.
+
+    ``gate(n)`` is asked how many of ``n`` failed elements may ladder-
+    re-run right now (the serving queue passes its
+    :class:`~slate_tpu.serve.admission.EscalationBudget`'s ``take``).
+    Elements past the allowance skip :func:`_escalate` entirely — they keep
+    their rung-1 payload/info, are marked ``capped`` in the side channel,
+    and their reports finalize ``recovered=False`` — so a retry storm from
+    a poisoned workload cannot starve fresh traffic.  ``None`` (the
+    default, and the direct-call path) means unlimited."""
+    prev = getattr(_tl, "esc_gate", None)
+    _tl.esc_gate = gate
+    return prev
 
 #: routine name -> pure single-matrix core (the vmapped rung-1 program)
 CORES = {
@@ -215,24 +232,40 @@ def _solve_batched(routine: str, A, B, opts, cache, donate):
                                precision_used=str(a0.dtype),
                                fallback_chain=("batched",))
                    for _ in range(batch)]
+    forced_bad: set = set()       # failed elements that never escalated —
+    #                               their recovered verdict is False even
+    #                               when info==0 (non-finite payload)
     if want_verdict:
         # the batch's single host sync: per-element info + finiteness
         bad = (np.asarray(info) != 0) | ~_finite_mask(payload[0])
         failed = [int(i) for i in np.nonzero(bad)[0]]
         if failed and opts.use_fallback_solver:
-            slots = [[p] for p in payload]
-            slots, info = _escalate(routine, ELEM_CORES[routine], a0, b,
-                                    failed, opts, slots, info, reports)
-            payload = [s[0] for s in slots]
-        elif reports is not None:
-            for i in failed:
-                reports[i].recovered = False
+            gate = getattr(_tl, "esc_gate", None)
+            allowed = len(failed) if gate is None else \
+                max(min(int(gate(len(failed))), len(failed)), 0)
+            run, capped = failed[:allowed], failed[allowed:]
+            if run:
+                slots = [[p] for p in payload]
+                slots, info = _escalate(routine, ELEM_CORES[routine], a0, b,
+                                        run, opts, slots, info, reports)
+                payload = [s[0] for s in slots]
+            for i in capped:
+                # budget refused the re-run: keep the rung-1 payload, mark
+                # the element so the serving queue resolves it with its
+                # typed error (recovered=False) instead of a silent retry
+                forced_bad.add(i)
+                _tl.escalations[i] = {"rungs": ("batched",),
+                                      "recovered": False, "capped": True}
+                count_event("slate_serve_escalations_capped_total",
+                            routine=routine)
+        elif failed:
+            forced_bad.update(failed)
     if reports is not None:
         final = np.asarray(info)
         for i, r in enumerate(reports):
             r.info = int(final[i])
             if len(r.fallback_chain) == 1:      # never escalated
-                r.recovered = r.info == 0
+                r.recovered = r.info == 0 and i not in forced_bad
             r.finalize()
     x = payload[0][..., 0] if squeeze else payload[0]
     x = write_back(B, x) if x.shape == as_array(B).shape else x

@@ -169,6 +169,13 @@ class ExecutableCache:
             return {"hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions, "size": len(self._table)}
 
+    def drop(self) -> None:
+        """Forget every compiled executable but KEEP the hit/miss counters —
+        the chaos ``cache_flush`` fault uses this so the recompiles it
+        forces stay visible as misses in the very stats that diagnose it."""
+        with self._lock:
+            self._table.clear()
+
     def clear(self) -> None:
         with self._lock:
             self._table.clear()

@@ -1,7 +1,9 @@
 """Flight recorder: a bounded ring of per-request postmortem records.
 
 When a served request fails — nonzero info after the whole escalation
-ladder, a worker-thread exception — the interesting evidence (which bucket
+ladder, a worker-thread exception, an admission-control rejection
+(``reason="shed"``) or an in-queue deadline expiry (``reason="deadline"``)
+— the interesting evidence (which bucket
 it hit, how long each stage took, whether the cache missed, which ladder
 rungs ran) is gone by the time anyone looks: the metrics registry only has
 aggregates and the chrome-trace is opt-in.  The flight recorder keeps the
@@ -62,6 +64,12 @@ class FlightRecord:
     ladder: Tuple[str, ...] = ()             # escalation rungs taken
     exhausted: bool = False                  # ladder ran out, still failing
     error: Optional[str] = None              # worker exception, if any
+    lane: str = ""                           # priority lane
+    #: why the request was rejected/expired instead of served — ``shed`` /
+    #: ``deadline`` / ``worker_error`` / ``worker_death`` (None = served);
+    #: the rejection-breakdown table in tools/obs_report.py groups on it
+    reason: Optional[str] = None
+    deadline_s: Optional[float] = None       # submitted deadline budget
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

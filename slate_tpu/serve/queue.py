@@ -14,8 +14,9 @@ wants large, shape-static batches.  The bridge is the classic serving recipe
   and the true solution rides in the leading block.
 * **Pack** requests of one (routine, bucket, dtype) into batches — flushed
   at ``max_batch`` or after ``max_wait_ms``, whichever first — and round
-  the batch axis up to a pow-2 bucket (repeating the last element) so batch
-  sizes, too, come from a bounded set and the executable cache stays small.
+  the batch axis up to a pow-2 bucket (identity-system ghost slots) so
+  batch sizes, too, come from a bounded set and the executable cache stays
+  small.
 
 Latency vs occupancy is the policy's one real tradeoff: larger
 ``max_batch``/``max_wait_ms`` raise solves/sec (better MXU occupancy,
@@ -23,6 +24,20 @@ fewer executable calls) and raise p99 (requests wait for the pack); the
 knobs are per-queue so latency-sensitive traffic can run a smaller pack.
 Every batch records its occupancy (real/padded) and every request its
 queue-to-result latency in the obs registry (``slate_serve_*``).
+
+Overload discipline (ROADMAP item 2(c), built on :mod:`.admission`):
+``submit(..., lane=, deadline=)`` places each request in a priority lane
+(``interactive`` > ``batch`` > ``best_effort``) with an optional deadline
+budget.  Admission is bounded — per-lane depth, global in-flight, token
+buckets, SLO-coupled shedding — and rejects with a typed
+:class:`~slate_tpu.core.exceptions.QueueOverloadError`.  The flush loop
+serves ready buckets in (lane priority, earliest deadline) order, flushes a
+bucket *early* when its oldest deadline is within the bucket's observed
+execute-p99, and expires still-queued past-deadline tickets with
+:class:`~slate_tpu.core.exceptions.DeadlineExceededError` before they waste
+a batch slot.  A dead worker thread fails queued tickets fast instead of
+letting ``result()`` hang; every rejection leaves a flight record with its
+reason (``shed`` / ``deadline`` / ``worker_death``).
 """
 
 from __future__ import annotations
@@ -38,10 +53,14 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..core.exceptions import SlateError, slate_assert
+from ..core.exceptions import (DeadlineExceededError, NumericalError,
+                               QueueOverloadError, SingularMatrixError,
+                               SlateError, slate_assert)
 from ..core.types import Options
+from ..robust.faults import inject_serve
 from ..utils import trace
 from . import batched as _batched
+from .admission import AdmissionController, DEFAULT_LANE, LANE_PRIORITY
 from .cache import ExecutableCache, default_cache
 from .flight import FlightRecord, FlightRecorder
 
@@ -58,6 +77,13 @@ _OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 #: far below the registry default's multi-minute top end
 _STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+#: the serving-fault injection site (robust.FaultSpec(driver=SERVE_SITE,
+#: kind="slow_executor" | "worker_crash" | "cache_flush"))
+SERVE_SITE = "serve_batch"
+
+#: execute-p99 lookups for the early-flush check are cached this long
+_P99_TTL_S = 0.5
 
 _TRACE_SEQ = itertools.count(1)
 
@@ -176,14 +202,18 @@ class Ticket:
     (submit / queue_wait / pad / cache / execute / resolve, seconds),
     the executable-cache verdict (``cache_hit``), and the escalation-ladder
     rungs taken (``ladder`` / ``exhausted``) — the same fields the flight
-    recorder persists.
+    recorder persists.  The overload contract adds ``lane`` (priority lane)
+    and ``deadline_s`` / ``t_deadline`` (the submitted budget and its
+    absolute ``perf_counter`` expiry; None = no deadline).
     """
 
     __slots__ = ("routine", "shape", "_event", "_value", "_error",
                  "t_submit", "t_submit_unix", "latency_s", "trace_id",
-                 "stages", "cache_hit", "ladder", "exhausted")
+                 "stages", "cache_hit", "ladder", "exhausted",
+                 "lane", "deadline_s", "t_deadline")
 
-    def __init__(self, routine: str, shape):
+    def __init__(self, routine: str, shape, lane: str = DEFAULT_LANE,
+                 deadline: Optional[float] = None):
         self.routine = routine
         self.shape = shape
         self._event = threading.Event()
@@ -197,6 +227,10 @@ class Ticket:
         self.cache_hit: Optional[bool] = None
         self.ladder: Tuple[str, ...] = ()
         self.exhausted = False
+        self.lane = lane
+        self.deadline_s = None if deadline is None else float(deadline)
+        self.t_deadline = (None if deadline is None
+                           else self.t_submit + float(deadline))
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -224,7 +258,9 @@ class _Pending:
         self.n, self.nrhs = n, nrhs
 
 
-def _normalize_request(policy: BucketPolicy, routine: str, a, b
+def _normalize_request(policy: BucketPolicy, routine: str, a, b,
+                       lane: str = DEFAULT_LANE,
+                       deadline: Optional[float] = None
                        ) -> Tuple[tuple, _Pending]:
     """One request -> its group key + pending record.  The single
     normalization path both verbs share (async ``submit`` and sync
@@ -242,8 +278,9 @@ def _normalize_request(policy: BucketPolicy, routine: str, a, b
     m, n = a.shape[-2:]
     bucket = policy.bucket(routine, m, n, b.shape[-1])
     _obs().counter("slate_serve_requests_total", "submitted requests").inc(
-        routine=routine, bucket="x".join(str(d) for d in bucket))
-    item = _Pending(Ticket(routine, (m, n, b.shape[-1])), a, b,
+        routine=routine, bucket="x".join(str(d) for d in bucket), lane=lane)
+    item = _Pending(Ticket(routine, (m, n, b.shape[-1]), lane=lane,
+                           deadline=deadline), a, b,
                     n, b.shape[-1])
     t1 = time.perf_counter()
     item.ticket.stages["submit"] = t1 - t0
@@ -258,7 +295,8 @@ def _stage_hist(obs, name: str, help: str):
 
 
 def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
-                   n_real: int, error: Optional[str] = None) -> FlightRecord:
+                   n_real: int, error: Optional[str] = None,
+                   reason: Optional[str] = None) -> FlightRecord:
     tk = it.ticket
     info = None
     if error is None and tk._value is not None:
@@ -268,13 +306,28 @@ def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
         dtype=str(it.a.dtype), t_submit_unix=tk.t_submit_unix,
         stages=dict(tk.stages), info=info, cache_hit=tk.cache_hit,
         batch=nb, occupancy=n_real / max(nb, 1), ladder=tk.ladder,
-        exhausted=tk.exhausted, error=error)
+        exhausted=tk.exhausted, error=error, lane=tk.lane, reason=reason,
+        deadline_s=tk.deadline_s)
+
+
+def _capped_error(routine: str, info: int) -> NumericalError:
+    """The typed error a capped-escalation element resolves with: its own
+    numerical failure class, annotated with why no ladder ran (``info==0``
+    means the verdict tripped on a non-finite payload, not a pivot)."""
+    what = f"info={info}" if info else "non-finite result"
+    msg = (f"serve: {routine} element failed ({what}) and the per-window "
+           "escalation budget was exhausted — no ladder re-run")
+    if info > 0:
+        return SingularMatrixError(msg, info=info)
+    return NumericalError(msg)
 
 
 def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
                       items: Sequence[_Pending], opts: Options,
                       cache: ExecutableCache, policy: BucketPolicy,
-                      flight: Optional[FlightRecorder] = None) -> None:
+                      flight: Optional[FlightRecorder] = None,
+                      esc_gate: Optional[Callable[[int], int]] = None
+                      ) -> None:
     """Pad + pack one bucket's requests, run the batched driver, distribute.
 
     Stage decomposition (per request, into ``ticket.stages`` + the
@@ -283,10 +336,30 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
     cache (executable lookup + possible compile, from the cache's per-call
     probe), execute (dispatch + compute + verdict sync, the driver call with
     the cache share subtracted), resolve (unpad + ticket delivery).
+
+    ``esc_gate`` (the queue's escalation budget) caps how many failed
+    elements may ladder-re-run; capped elements resolve with their typed
+    numerical error.  Serving chaos (an active
+    :class:`~slate_tpu.robust.FaultPlan` with ``serve``-point specs at
+    :data:`SERVE_SITE`) fires here, before the batch executes:
+    ``slow_executor`` stalls, ``cache_flush`` wipes the executable cache,
+    ``worker_crash`` raises — which in the async queue kills the worker
+    thread and exercises the fail-fast path.
     """
     obs = _obs()
     bucket_s = "x".join(str(d) for d in bucket)
     labels = {"routine": routine, "bucket": bucket_s}
+    for spec in inject_serve(SERVE_SITE):
+        if spec.kind == "slow_executor":
+            time.sleep(spec.delay_s)
+        elif spec.kind == "cache_flush":
+            cache.drop()
+            obs.counter("slate_serve_cache_flushes_total",
+                        "chaos-injected executable-cache wipes").inc(**labels)
+        elif spec.kind == "worker_crash":
+            # deliberately NOT a SlateError: simulates an unexpected crash
+            # (the class the worker-death handler must survive)
+            raise RuntimeError("chaos: injected worker crash")
     t0 = time.perf_counter()
     nb = policy.round_batch(len(items))
     for it in items:                      # stage: queue wait (per request)
@@ -300,11 +373,20 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
     cache_s = 0.0
     cache_info = None
     res_spans: List[Tuple[float, float]] = []
+    prev_gate = _batched.set_escalation_gate(esc_gate)
     try:
         t_pad0 = time.perf_counter()      # stage: pad + pack
         padded = [pad_request(routine, it.a, it.b, bucket) for it in items]
-        while len(padded) < nb:
-            padded.append(padded[-1])       # repeat-pad the batch axis
+        if len(padded) < nb:
+            # ghost batch slots are well-posed identity systems (I x = 0;
+            # SPD, full-rank — valid for all three routines), NOT copies of
+            # the last request: a failing real element must not multiply
+            # its own failure across the pad and burn escalation budget /
+            # ladder re-runs on ghosts
+            ghost = (np.eye(bucket[0], bucket[1], dtype=padded[0][0].dtype),
+                     np.zeros((bucket[0], bucket[2]),
+                              dtype=padded[0][1].dtype))
+            padded += [ghost] * (nb - len(padded))
         # one host->device transfer per packed operand, not one per request
         A = jnp.asarray(np.stack([p[0] for p in padded]))
         B = jnp.asarray(np.stack([p[1] for p in padded]))
@@ -342,10 +424,12 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
             tk.stages["cache"] = cache_s
             tk.stages["execute"] = exec_s
             tk.cache_hit = (cache_info or {}).get("hit")
+            capped = False
             e = escal.get(i)
             if e is not None:
                 tk.ladder = tuple(e["rungs"])
                 tk.exhausted = not e["recovered"]
+                capped = bool(e.get("capped"))
             if int(infos[i]) != 0:
                 tk.exhausted = True
             # per-request interval: this request's OWN unpad, stamped before
@@ -356,7 +440,18 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
             tk.stages["resolve"] = now - t_res
             res_spans.append((t_res, now))
             t_res = now
-            tk._resolve(value)
+            # a capped element is bad by info OR by finiteness (the same
+            # verdict that queued it for escalation — an overflowed payload
+            # can carry info==0)
+            if capped and (int(infos[i]) != 0
+                           or not np.all(np.isfinite(xs[i]))):
+                # the graceful-degradation contract: a failed element whose
+                # ladder re-run the budget refused resolves with its typed
+                # error (recovered=False), not a silent bad payload
+                tk.exhausted = True
+                tk._resolve(error=_capped_error(routine, int(infos[i])))
+            else:
+                tk._resolve(value)
     # slate-lint: disable=SLT501 -- not a swallow: the exception (taxonomy
     # included) is re-surfaced on every pending ticket, whose result() call
     # re-raises it in the submitter's thread; raising here would instead
@@ -377,12 +472,14 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
             if flight is not None:
                 last_rec = _flight_record(it, routine, bucket_s, nb,
                                           len(items),
-                                          error=f"{type(e).__name__}: {e}")
+                                          error=f"{type(e).__name__}: {e}",
+                                          reason="worker_error")
                 flight.record(last_rec)
         if flight is not None and last_rec is not None:
             flight.on_exhaustion(last_rec, reason="worker_error")
         return
     finally:
+        _batched.set_escalation_gate(prev_gate)
         obs.counter("slate_serve_batches_total",
                     "executed batches").inc(**labels)
         obs.histogram("slate_serve_batch_occupancy",
@@ -395,9 +492,12 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
     exhausted_rec = None
     for i, it in enumerate(items):
         tk = it.ticket
+        # the lane label is what lane-level latency SLOs (the overload
+        # soak's interactive-p99 objective) filter on; per-routine SLOs
+        # still subset-match on routine alone
         _stage_hist(obs, "slate_serve_latency_seconds",
                     "submit-to-result latency per request").observe(
-                        tk.latency_s, routine=routine)
+                        tk.latency_s, routine=routine, lane=tk.lane)
         if trace.is_on():
             # retrospective per-request stage spans: one request's lifeline,
             # stitchable from the interleaved timeline by args.trace_id
@@ -411,7 +511,10 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
                             **common)
             trace.emit_span("serve.resolve", *res_spans[i], **common)
         if flight is not None:
-            rec = _flight_record(it, routine, bucket_s, nb, len(items))
+            err_s = (f"{type(tk._error).__name__}: {tk._error}"
+                     if tk._error is not None else None)
+            rec = _flight_record(it, routine, bucket_s, nb, len(items),
+                                 error=err_s)
             flight.record(rec)
             if tk.exhausted:
                 exhausted_rec = rec
@@ -431,28 +534,47 @@ class ServeQueue:
         t = q.submit("gesv", a, b)        # a (n, n), b (n,) or (n, nrhs)
         x, info = t.result()
 
-    A background worker packs pending requests per (routine, bucket, dtype)
-    and flushes on ``max_batch`` / ``max_wait_ms`` (see
-    :class:`BucketPolicy`).  ``close()`` drains and stops the worker; the
-    queue is also a context manager.
+        t = q.submit("gesv", a, b, lane="best_effort", deadline=0.5)
+
+    A background worker packs pending requests per (lane, routine, bucket,
+    dtype) and flushes on ``max_batch`` / ``max_wait_ms`` (see
+    :class:`BucketPolicy`) in (lane priority, earliest deadline) order —
+    early when a deadline is within the bucket's observed execute-p99.
+    ``admission`` (an :class:`~slate_tpu.serve.admission.AdmissionPolicy`
+    or a pre-built controller) bounds what gets in; rejected submissions
+    raise :class:`QueueOverloadError`, expired tickets resolve with
+    :class:`DeadlineExceededError`.  ``close()`` drains and stops the
+    worker; the queue is also a context manager.
     """
 
     def __init__(self, policy: Optional[BucketPolicy] = None,
                  opts: Optional[Options] = None,
                  cache: Optional[ExecutableCache] = None,
                  start: bool = True,
-                 flight: Optional[FlightRecorder] = None):
+                 flight: Optional[FlightRecorder] = None,
+                 admission: Optional[object] = None):
         self.policy = policy or BucketPolicy()
         self.opts = Options.make(opts)
         self.cache = default_cache() if cache is None else cache
         self.flight = FlightRecorder() if flight is None else flight
+        if isinstance(admission, AdmissionController):
+            self.admission = admission
+        else:
+            self.admission = AdmissionController(admission)
         self._slo_monitor = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        #: full key = (lane, routine, bucket, dtype)
         self._pending: Dict[tuple, List[_Pending]] = {}
         self._oldest: Dict[tuple, float] = {}
+        self._min_deadline: Dict[tuple, float] = {}
+        self._depths: Dict[str, int] = {}
         self._inflight = 0           # popped off _pending, not yet served
+        self._current_work: List[_Pending] = []
+        self._early_ready: set = set()
+        self._p99_cache: Dict[Tuple[str, str], Tuple[float, float]] = {}
         self._closed = False
+        self._worker_died: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
         if start:
             self._worker = threading.Thread(target=self._loop, daemon=True,
@@ -460,15 +582,96 @@ class ServeQueue:
             self._worker.start()
 
     # -- submission ----------------------------------------------------------
-    def submit(self, routine: str, a, b) -> Ticket:
-        key, item = _normalize_request(self.policy, routine, a, b)
+    def submit(self, routine: str, a, b, lane: str = DEFAULT_LANE,
+               deadline: Optional[float] = None) -> Ticket:
+        """Submit one solve; returns its :class:`Ticket`.
+
+        lane:     priority lane (:data:`~slate_tpu.serve.admission.LANES`);
+                  interactive outranks batch outranks best_effort.
+        deadline: seconds of budget from now; the queue expires the ticket
+                  with :class:`DeadlineExceededError` once it runs out and
+                  flushes its bucket early when the budget nears the
+                  bucket's observed execute-p99.
+
+        Raises :class:`QueueOverloadError` when admission control sheds the
+        request, and :class:`SlateError` immediately (never a hung ticket)
+        when the queue is closed or its worker thread has died."""
+        if lane not in LANE_PRIORITY:
+            raise SlateError(f"serve: unknown lane {lane!r}; "
+                             f"expected one of {sorted(LANE_PRIORITY)}")
+        if deadline is not None and deadline <= 0:
+            raise SlateError(f"serve: deadline must be positive seconds, "
+                             f"got {deadline}")
+        if self._slo_monitor is not None:
+            # throttled: re-consume the SLO verdicts at most every
+            # policy.slo_refresh_s — the admission decision itself reads a
+            # cached shed set and stays O(1)
+            self.admission.maybe_refresh(self.slo_verdicts)
+        key, item = _normalize_request(self.policy, routine, a, b,
+                                       lane=lane, deadline=deadline)
+        overload: Optional[QueueOverloadError] = None
         with self._cv:
-            if self._closed:
-                raise SlateError("serve: queue is closed")
-            self._pending.setdefault(key, []).append(item)
-            self._oldest.setdefault(key, time.perf_counter())
-            self._cv.notify()
+            self._check_alive()
+            depth = self._depths.get(lane, 0)
+            try:
+                self.admission.admit(lane, depth, self._unresolved())
+            except QueueOverloadError as e:
+                overload = e
+            else:
+                fk = (lane,) + key
+                self._pending.setdefault(fk, []).append(item)
+                self._depths[lane] = depth + 1
+                self._depth_gauge(lane)
+                self._oldest.setdefault(fk, time.perf_counter())
+                td = item.ticket.t_deadline
+                if td is not None:
+                    cur = self._min_deadline.get(fk)
+                    if cur is None or td < cur:
+                        self._min_deadline[fk] = td
+                self._cv.notify()
+        if overload is not None:
+            self._record_shed(item, key, overload)
+            raise overload
         return item.ticket
+
+    def _check_alive(self) -> None:
+        """Raise (don't enqueue a ticket that can never resolve) when the
+        queue is closed or the worker thread is gone.  Caller holds the
+        lock.  ``start=False`` queues have no worker and stay usable for
+        warm-up / inspection."""
+        if self._closed:
+            raise SlateError("serve: queue is closed")
+        if self._worker_died is not None:
+            raise SlateError(
+                "serve: worker thread died "
+                f"({type(self._worker_died).__name__}: {self._worker_died});"
+                " queue is unusable — create a new ServeQueue")
+        if self._worker is not None and not self._worker.is_alive():
+            raise SlateError("serve: worker thread is not running")
+
+    def _unresolved(self) -> int:
+        """Admitted-but-unresolved count (pending + popped-for-execution);
+        the admission controller's in-flight signal.  Caller holds the
+        lock."""
+        return sum(self._depths.values()) + self._inflight
+
+    def _record_shed(self, item: _Pending, key: tuple,
+                     err: QueueOverloadError) -> None:
+        """A rejection is evidence: counter, trace event, flight record,
+        and the ticket resolved with the error (anyone holding it sees the
+        same typed failure the submitter caught)."""
+        tk = item.ticket
+        routine, bucket, _ = key
+        bucket_s = "x".join(str(d) for d in bucket)
+        _obs().counter("slate_serve_shed_total",
+                       "requests rejected by admission control").inc(
+                           lane=tk.lane, reason=err.reason, routine=routine)
+        trace.trace_event("shed", routine=routine, lane=tk.lane,
+                          reason=err.reason, trace_id=tk.trace_id)
+        tk._resolve(error=err)
+        self.flight.record(_flight_record(
+            item, routine, bucket_s, 0, 0,
+            error=f"{type(err).__name__}: {err}", reason="shed"))
 
     def warmup(self, combos: Sequence[Tuple[str, int, int, int]],
                dtype=jnp.float32) -> int:
@@ -498,8 +701,29 @@ class ServeQueue:
         return seen
 
     # -- worker --------------------------------------------------------------
+    def _exec_p99(self, routine: str, bucket_s: str, now: float) -> float:
+        """Observed execute-stage p99 for one (routine, bucket) — the
+        early-flush threshold — from the PR 6 stage histograms, cached for
+        ``_P99_TTL_S`` so the flush loop stays O(pending keys)."""
+        ent = self._p99_cache.get((routine, bucket_s))
+        if ent is not None and now - ent[1] < _P99_TTL_S:
+            return ent[0]
+        h = _obs().REGISTRY.get("slate_serve_execute_seconds")
+        q = h.quantile(0.99, routine=routine, bucket=bucket_s) \
+            if h is not None else None
+        q = float(q) if q is not None else 0.0
+        self._p99_cache[(routine, bucket_s)] = (q, now)
+        return q
+
+    def _key_order(self, key: tuple) -> tuple:
+        """(lane priority, earliest deadline, oldest arrival) sort key."""
+        return (LANE_PRIORITY.get(key[0], len(LANE_PRIORITY)),
+                self._min_deadline.get(key, float("inf")),
+                self._oldest.get(key, float("inf")))
+
     def _ready_keys(self, now: float) -> List[tuple]:
         ready = []
+        early = set()
         for key, items in self._pending.items():
             if not items:
                 continue
@@ -507,9 +731,109 @@ class ServeQueue:
             if len(items) >= self.policy.max_batch \
                     or age_ms >= self.policy.max_wait_ms:
                 ready.append(key)
+                continue
+            md = self._min_deadline.get(key)
+            if md is None:
+                continue
+            # deadline-aware: flush early when the tightest budget in the
+            # bucket is within the bucket's observed execute-p99 (or has
+            # already expired and must be swept out of the queue)
+            _, routine, bucket, _d = key
+            bucket_s = "x".join(str(d) for d in bucket)
+            if md - now <= self._exec_p99(routine, bucket_s, now):
+                if md > now:
+                    early.add(key)       # counted at pop time, not per scan
+                ready.append(key)
+        ready.sort(key=self._key_order)
+        self._early_ready = early
         return ready
 
+    def _depth_gauge(self, lane: str) -> None:
+        """Publish one lane's pending depth (caller holds the lock — every
+        mutation of ``_depths`` refreshes the gauge, so it never goes
+        stale)."""
+        _obs().gauge("slate_serve_lane_depth",
+                     "pending tickets per priority lane").set(
+                         self._depths.get(lane, 0), lane=lane)
+
+    def _requeue_locked(self, key: tuple,
+                        remaining: List[_Pending]) -> None:
+        """Re-point one key's pending/oldest/min-deadline state at
+        ``remaining`` (possibly empty) after some items were taken out —
+        the ONE place the three per-key maps are kept in sync (caller
+        holds the lock)."""
+        if remaining:
+            self._pending[key] = remaining
+            self._oldest[key] = remaining[0].ticket.t_submit
+            mds = [it.ticket.t_deadline for it in remaining
+                   if it.ticket.t_deadline is not None]
+            if mds:
+                self._min_deadline[key] = min(mds)
+            else:
+                self._min_deadline.pop(key, None)
+        else:
+            self._pending.pop(key, None)
+            self._oldest.pop(key, None)
+            self._min_deadline.pop(key, None)
+
+    def _sweep_expired_locked(self, now: float) -> List[Tuple[tuple,
+                                                              _Pending]]:
+        """Pull every past-deadline ticket out of EVERY lane's pending
+        lists (caller holds the lock; resolution happens outside it).
+        Runs each worker cycle regardless of which bucket wins the pop, so
+        an expired low-lane ticket never waits behind sustained
+        higher-lane traffic — expiry costs no batch slot."""
+        out: List[Tuple[tuple, _Pending]] = []
+        for key in [k for k, md in list(self._min_deadline.items())
+                    if md <= now]:
+            items = self._pending.get(key)
+            if not items:
+                continue
+            live = []
+            for it in items:
+                td = it.ticket.t_deadline
+                if td is not None and now >= td:
+                    out.append((key, it))
+                else:
+                    live.append(it)
+            self._requeue_locked(key, live)
+            lane = key[0]
+            self._depths[lane] = max(
+                self._depths.get(lane, 0) - (len(items) - len(live)), 0)
+            self._depth_gauge(lane)
+        return out
+
+    def _next_wait(self, now: float) -> Optional[float]:
+        """Seconds the worker may sleep before some bucket could become
+        ready (None = nothing pending).  Caller holds the lock."""
+        wait = None
+        for key, items in self._pending.items():
+            if not items:
+                continue
+            w = self._oldest[key] + self.policy.max_wait_ms / 1e3 - now
+            md = self._min_deadline.get(key)
+            if md is not None:
+                lane, routine, bucket, _ = key
+                bucket_s = "x".join(str(d) for d in bucket)
+                w = min(w, md - self._exec_p99(routine, bucket_s, now) - now)
+            wait = w if wait is None else min(wait, w)
+        return None if wait is None else max(wait, 1e-4)
+
     def _loop(self):
+        try:
+            self._serve_loop()
+        # slate-lint: disable=SLT501 -- not a swallow: this is the worker-
+        # death boundary; the exception (taxonomy included) is re-surfaced
+        # on every queued ticket by _on_worker_death, and no solve runs
+        # inside this frame after the handler
+        except BaseException as e:  # noqa: BLE001 - resurfaced on tickets
+            self._on_worker_death(e)
+
+    def _serve_loop(self):
+        # one highest-priority bucket chunk per cycle: lane priority and
+        # deadlines are re-evaluated BETWEEN batches, so a deep low-lane
+        # backlog cannot capture the worker for more than one batch while
+        # interactive traffic queues behind it
         while True:
             with self._cv:
                 while True:
@@ -517,38 +841,126 @@ class ServeQueue:
                     ready = self._ready_keys(now)
                     if ready or self._closed:
                         break
-                    # sleep until the oldest pending bucket hits max_wait
-                    if self._pending and any(self._pending.values()):
-                        oldest = min(self._oldest[k]
-                                     for k, v in self._pending.items() if v)
-                        wait = max(self.policy.max_wait_ms / 1e3
-                                   - (now - oldest), 1e-4)
+                    wait = self._next_wait(now)
+                    if wait is not None:
                         self._cv.wait(timeout=wait)
                     else:
                         self._cv.wait()
                 if self._closed and not any(self._pending.values()):
                     return
-                work = []
-                for key in (ready or list(self._pending)):
-                    items = self._pending.pop(key, [])
-                    self._oldest.pop(key, None)
-                    if items:
-                        work.append((key, items))
-                # popped-but-unserved requests are invisible in _pending;
-                # _inflight keeps flush() honest about them
-                self._inflight += sum(len(i) for _, i in work)
+                # sweep past-deadline tickets out of EVERY lane first —
+                # expiry must not queue behind the pop choice below
+                now = time.perf_counter()
+                expired = self._sweep_expired_locked(now)
+                candidates = [
+                    k for k in (ready or sorted(
+                        (k for k, v in self._pending.items() if v),
+                        key=self._key_order))
+                    if self._pending.get(k)]
+                key = candidates[0] if candidates else None
+                live: List[_Pending] = []
+                if key is not None:
+                    items = self._pending.get(key, [])
+                    live = items[:self.policy.max_batch]
+                    self._requeue_locked(key, items[self.policy.max_batch:])
+                    lane = key[0]
+                    self._depths[lane] = max(
+                        self._depths.get(lane, 0) - len(live), 0)
+                    self._depth_gauge(lane)
+                    if key in self._early_ready:
+                        # one sample per ACTUAL deadline-driven flush (the
+                        # ready scan may re-flag a waiting bucket many times)
+                        self._early_ready.discard(key)
+                        _obs().counter(
+                            "slate_serve_early_flush_total",
+                            "deadline-driven flushes ahead of max_wait").inc(
+                                routine=key[1], lane=lane)
+                    # popped-but-unserved requests are invisible in
+                    # _pending; _inflight keeps flush() honest about them
+                    # (and _current_work lets the death handler fail them
+                    # fast)
+                    self._inflight += len(live)
+                    self._current_work = list(live)
+            for k, it in expired:
+                self._expire(k, it)
+            if not live:
+                continue
             try:
-                for (routine, bucket, _), items in work:
-                    for chunk0 in range(0, len(items), self.policy.max_batch):
-                        _run_bucket_batch(
-                            routine, bucket,
-                            items[chunk0:chunk0 + self.policy.max_batch],
-                            self.opts, self.cache, self.policy,
-                            flight=self.flight)
+                _run_bucket_batch(
+                    key[1], key[2], live, self.opts, self.cache,
+                    self.policy, flight=self.flight,
+                    esc_gate=self.admission.escalations.take)
             finally:
                 with self._cv:
-                    self._inflight -= sum(len(i) for _, i in work)
+                    self._inflight -= len(live)
+                    # keep unresolved tickets visible: if an exception is
+                    # unwinding this frame, the death handler fails exactly
+                    # these fast (served tickets are done() and drop out)
+                    self._current_work = [
+                        it for it in self._current_work
+                        if not it.ticket.done()]
                     self._cv.notify_all()
+
+    def _expire(self, key: tuple, it: _Pending) -> None:
+        """Resolve one past-deadline ticket with its typed error — before
+        it wastes a batch slot — and leave the evidence trail."""
+        tk = it.ticket
+        lane, routine, bucket, _ = key
+        bucket_s = "x".join(str(d) for d in bucket)
+        elapsed = time.perf_counter() - tk.t_submit
+        err = DeadlineExceededError(lane=lane, deadline_s=tk.deadline_s or 0.0,
+                                    elapsed_s=elapsed)
+        _obs().counter("slate_serve_deadline_expired_total",
+                       "tickets expired in-queue past their deadline").inc(
+                           lane=lane, routine=routine)
+        trace.trace_event("deadline_expired", routine=routine, lane=lane,
+                          trace_id=tk.trace_id)
+        tk._resolve(error=err)
+        self.flight.record(_flight_record(
+            it, routine, bucket_s, 0, 0,
+            error=f"{type(err).__name__}: {err}", reason="deadline"))
+
+    def _on_worker_death(self, exc: BaseException) -> None:
+        """The worker thread is gone: fail every queued and in-flight
+        ticket *now* with a typed error instead of letting ``result()``
+        hang to its timeout, and leave counters + flight records behind."""
+        obs = _obs()
+        obs.counter("slate_serve_worker_deaths_total",
+                    "serving worker threads lost to exceptions").inc(
+                        error=type(exc).__name__)
+        trace.trace_event("worker_death", error=type(exc).__name__)
+        with self._cv:
+            self._worker_died = exc
+            stranded: List[Tuple[tuple, _Pending]] = []
+            for k, items in self._pending.items():
+                stranded.extend((k, it) for it in items)
+            self._pending.clear()
+            self._oldest.clear()
+            self._min_deadline.clear()
+            for lane in list(self._depths):
+                self._depths[lane] = 0
+                self._depth_gauge(lane)
+            self._depths.clear()
+            inflight = list(self._current_work)
+            self._current_work = []
+            self._inflight = 0
+            self._cv.notify_all()
+        err = SlateError(f"serve: worker thread died: "
+                         f"{type(exc).__name__}: {exc}")
+        last_rec = None
+        victims = [it for _, it in stranded] + inflight
+        for it in victims:
+            if not it.ticket.done():
+                it.ticket._resolve(error=err)
+            routine = it.ticket.routine
+            m, n, nrhs = it.ticket.shape
+            bucket = self.policy.bucket(routine, m, n, nrhs)
+            last_rec = _flight_record(
+                it, routine, "x".join(str(d) for d in bucket), 0, 0,
+                error=f"{type(exc).__name__}: {exc}", reason="worker_death")
+            self.flight.record(last_rec)
+        if last_rec is not None:
+            self.flight.on_exhaustion(last_rec, reason="worker_death")
 
     # -- telemetry -----------------------------------------------------------
     def dump_flight(self, path: Optional[str] = None) -> str:
@@ -558,8 +970,9 @@ class ServeQueue:
 
     def attach_slo(self, monitor) -> None:
         """Attach an :class:`~slate_tpu.obs.slo.SLOMonitor`; its verdicts
-        become this queue's admission-control signal
-        (:meth:`slo_verdicts` / :meth:`slo_status`)."""
+        become this queue's admission-control signal: the controller
+        consumes them (throttled) on every submit, shedding lanes per the
+        :class:`~slate_tpu.serve.admission.AdmissionPolicy` ladder."""
         self._slo_monitor = monitor
 
     def slo_verdicts(self):
@@ -571,13 +984,17 @@ class ServeQueue:
         """The last published SLO verdict codes, straight from the registry
         gauges (``{slo name: 0 ok / 1 warning / 2 breach / -1 no data}``) —
         readable whether this queue, another queue, or an external monitor
-        evaluated them.  The hook ROADMAP item 2(c)'s admission control
-        reads before admitting a request."""
+        evaluated them."""
         g = _obs().REGISTRY.get("slate_slo_status")
         if g is None:
             return {}
         return {dict(key).get("slo", "?"): int(val)
                 for key, val in g.series().items()}
+
+    def lane_depths(self) -> Dict[str, int]:
+        """Current pending-ticket count per lane (a point-in-time read)."""
+        with self._cv:
+            return {lane: d for lane, d in self._depths.items() if d}
 
     # -- lifecycle -----------------------------------------------------------
     def flush(self, timeout: float = 30.0) -> None:
@@ -588,6 +1005,8 @@ class ServeQueue:
         with self._cv:
             self._cv.notify_all()      # wake the worker for age-based flushes
             while any(self._pending.values()) or self._inflight:
+                if self._worker_died is not None:
+                    return             # death handler already failed tickets
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError("serve: flush timed out")
@@ -618,7 +1037,8 @@ def solve_many(requests: Sequence[Tuple[str, Any, Any]],
     (``(routine, a, b)`` triples) in one pass, returning ``(x, info)`` per
     request *in submission order*.  The deterministic sibling of
     :class:`ServeQueue` — same bucketing/padding/batching policy, no worker
-    thread, used by the bench workload and the CI smoke."""
+    thread, no admission control (every request runs), used by the bench
+    workload and the CI smoke."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = default_cache() if cache is None else cache

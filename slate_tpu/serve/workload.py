@@ -9,6 +9,16 @@ first, so the measured pass exercises the steady state: zero compiles, warm
 executable cache) and reports throughput + latency percentiles + cache and
 occupancy statistics.  Used by ``bench.py --child serve_mixed`` and the CI
 ``serving-smoke`` step (tools/serving_smoke.py).
+
+``run_overload_workload`` is the chaos sibling: it first *measures* the
+queue's capacity (a warm calibration burst), then drives seeded
+heavy-tailed arrivals at ``capacity_factor``× that rate across the three
+priority lanes, with deadlines on interactive traffic and an
+:class:`~slate_tpu.serve.admission.AdmissionPolicy` that bounds the lanes —
+the overload soak (tests/test_admission.py) and the CI ``overload-smoke``
+step (tools/overload_smoke.py) assert its contract: interactive p99 SLO
+non-breach, shedding lands on the right lanes with typed errors, zero hung
+tickets, a flight record for every rejection.
 """
 
 from __future__ import annotations
@@ -18,7 +28,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.exceptions import (DeadlineExceededError, NumericalError,
+                               QueueOverloadError, SlateError)
 from ..core.types import Options
+from .admission import AdmissionPolicy, LANES
 from .cache import ExecutableCache
 from .flight import FlightRecorder
 from .queue import BucketPolicy, ServeQueue, solve_many
@@ -158,4 +171,189 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
         stats["p50_ms"] = stats["p99_ms"] = None
     if return_tickets:
         stats["tickets"] = tickets
+    return stats
+
+
+#: overload-mode lane mix: mostly interactive+batch, a best-effort tail —
+#: the shape where the shed ladder must land on the right lanes
+DEFAULT_LANE_MIX = (("interactive", 0.35), ("batch", 0.35),
+                    ("best_effort", 0.30))
+
+
+def default_overload_admission(capacity: float) -> AdmissionPolicy:
+    """The overload contract the soak runs under, sized from *measured*
+    capacity: shallow bounded lanes (deepest for batch, shallowest for
+    best-effort) and a best-effort token bucket at 25% of capacity — under
+    ``>=2x`` overload the best-effort lane MUST shed while interactive's
+    demand share stays under what the queue can serve."""
+    return AdmissionPolicy(
+        max_depth={"interactive": 512, "batch": 1024, "best_effort": 64},
+        max_in_flight=4096,
+        rate={"best_effort": max(0.25 * capacity, 1.0)},
+        burst={"best_effort": max(0.25 * capacity, 8.0)},
+    )
+
+
+def measure_capacity(q: ServeQueue, reqs: Sequence[Tuple[str, Any, Any]],
+                     opts: Optional[Options] = None) -> float:
+    """Warm-path solves/sec of this queue's policy+cache on ``reqs`` — the
+    calibration burst the overload arrival rate is sized from (synchronous
+    ``solve_many``: no queue waits, pure serve throughput)."""
+    t0 = time.perf_counter()
+    solve_many(reqs, opts=opts or q.opts, policy=q.policy, cache=q.cache)
+    return len(reqs) / max(time.perf_counter() - t0, 1e-9)
+
+
+def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
+                          policy: Optional[BucketPolicy] = None,
+                          opts: Optional[Options] = None,
+                          dims: Sequence[int] = (8, 13, 24),
+                          routines: Sequence[str] = DEFAULT_ROUTINES,
+                          admission: Optional[AdmissionPolicy] = None,
+                          capacity_factor: float = 2.0,
+                          lane_mix: Sequence[Tuple[str, float]]
+                          = DEFAULT_LANE_MIX,
+                          deadlines: Optional[Dict[str, float]] = None,
+                          calibrate_requests: int = 150,
+                          max_requests: int = 20_000,
+                          pool: int = 400,
+                          flight: Optional[FlightRecorder] = None,
+                          after_warmup: Optional[Callable[[ServeQueue], None]]
+                          = None,
+                          drain_timeout_s: float = 60.0) -> Dict[str, Any]:
+    """Drive the serving queue past its measured capacity; return the tally.
+
+    Three phases: (1) warm up every executable and *measure* capacity with
+    a synchronous burst; (2) replay a seeded, heavy-tailed (Pareto
+    inter-arrival) open-loop arrival process at ``capacity_factor`` × that
+    capacity for ``duration_s``, each request assigned a lane by
+    ``lane_mix`` and a deadline by ``deadlines`` (default: interactive
+    carries a budget, lower lanes run without); (3) drain, then classify
+    every submitted request exactly once: served ok / numerically failed /
+    shed (:class:`QueueOverloadError`, counted per lane+reason) / expired
+    (:class:`DeadlineExceededError`) / worker-failed / hung (result still
+    pending after the drain — the contract says this must be zero).
+
+    ``after_warmup(q)`` runs between calibration and the overload pass
+    (attach the SLO monitor / start the sampler there).  The returned stats
+    carry the measured capacity, the offered rate, per-lane submit/shed/
+    expire/ok counts, latency p50/p99 per lane, and ``hung``."""
+    policy = policy or BucketPolicy()
+    opts = Options.make(opts)
+    cache = ExecutableCache()
+    rng = np.random.default_rng(seed)
+    reqs = make_requests(pool, seed, dims=dims, routines=routines)
+    combos = sorted({(r, a.shape[0], a.shape[1], b.shape[1])
+                     for r, a, b in reqs})
+
+    warm_q = ServeQueue(policy=policy, opts=opts, cache=cache, start=False)
+    t0 = time.perf_counter()
+    warm_q.warmup(combos, dtype=reqs[0][1].dtype)
+    warmup_s = time.perf_counter() - t0
+    warm_q.close()
+    capacity = measure_capacity(warm_q, reqs[:calibrate_requests], opts=opts)
+
+    admission = admission or default_overload_admission(capacity)
+    q = ServeQueue(policy=policy, opts=opts, cache=cache, flight=flight,
+                   admission=admission)
+    if after_warmup is not None:
+        after_warmup(q)
+
+    lanes, weights = zip(*lane_mix)
+    weights = np.asarray(weights, float) / sum(w for _, w in lane_mix)
+    deadlines = {"interactive": 5.0} if deadlines is None else deadlines
+    target_rate = capacity_factor * capacity
+    # Pareto(alpha) inter-arrivals: heavy-tailed bursts around a controlled
+    # mean — E[gap] = xm * alpha/(alpha-1), solved for the target rate
+    alpha = 1.8
+    xm = (alpha - 1) / (alpha * target_rate)
+
+    submitted: List[Tuple[str, Any]] = []        # (lane, ticket)
+    shed: Dict[str, int] = {}
+    shed_reasons: Dict[str, int] = {}
+    per_lane_submit: Dict[str, int] = {lane: 0 for lane in LANES}
+    aborted: Optional[str] = None
+    t_start = time.perf_counter()
+    t_next = t_start
+    n = 0
+    try:
+        while (time.perf_counter() - t_start) < duration_s \
+                and n < max_requests:
+            routine, a, b = reqs[int(rng.integers(len(reqs)))]
+            lane = str(lanes[int(rng.choice(len(lanes), p=weights))])
+            per_lane_submit[lane] = per_lane_submit.get(lane, 0) + 1
+            n += 1
+            try:
+                t = q.submit(routine, a, b, lane=lane,
+                             deadline=deadlines.get(lane))
+                submitted.append((lane, t))
+            except QueueOverloadError as e:
+                shed[lane] = shed.get(lane, 0) + 1
+                shed_reasons[e.reason] = shed_reasons.get(e.reason, 0) + 1
+            except SlateError as e:
+                # queue closed / worker died mid-run: stop offering but
+                # KEEP the tally — the already-submitted tickets were
+                # failed fast by the death handler and classify below
+                aborted = f"{type(e).__name__}: {e}"
+                break
+            t_next += xm * (1.0 + rng.pareto(alpha))
+            pause = t_next - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+        offered_s = time.perf_counter() - t_start
+
+        # -- drain + classify every admitted ticket exactly once ------------
+        try:
+            q.flush(timeout=drain_timeout_s)
+        except TimeoutError:
+            pass                   # hung tickets are counted (and gated) below
+        ok = bad = expired = worker_failed = capped = hung = 0
+        expired_by_lane: Dict[str, int] = {}
+        lat_by_lane: Dict[str, List[float]] = {}
+        for lane, t in submitted:
+            if not t.done():
+                hung += 1
+                continue
+            try:
+                _, info = t.result(timeout=0)
+                ok += int(info == 0)
+                bad += int(info != 0)
+                lat_by_lane.setdefault(lane, []).append(t.latency_s)
+            except DeadlineExceededError:
+                expired += 1
+                expired_by_lane[lane] = expired_by_lane.get(lane, 0) + 1
+            except NumericalError:
+                capped += 1        # typed numerical error (escalation cap)
+            except SlateError:
+                worker_failed += 1  # worker-death resolution (fail-fast)
+            # slate-lint: disable=SLT501 -- tally, not a swallow: the
+            # taxonomy classes are caught (and counted) explicitly above;
+            # anything else is an unexpected worker error the stats
+            # surface as worker_failed
+            except Exception:      # unexpected driver error
+                worker_failed += 1
+    finally:
+        q.close()
+
+    stats: Dict[str, Any] = {
+        "capacity_solves_per_sec": round(capacity, 1),
+        "target_rate": round(target_rate, 1),
+        "offered": n,
+        "offered_rate": round(n / max(offered_s, 1e-9), 1),
+        "duration_s": round(offered_s, 2),
+        "warmup_s": round(warmup_s, 3),
+        "admitted": len(submitted),
+        "ok": ok, "bad": bad, "capped": capped,
+        "worker_failed": worker_failed,
+        "expired": expired, "expired_by_lane": expired_by_lane,
+        "shed": sum(shed.values()), "shed_by_lane": dict(shed),
+        "shed_reasons": dict(shed_reasons),
+        "aborted": aborted,
+        "submitted_by_lane": {k: v for k, v in per_lane_submit.items() if v},
+        "hung": hung,
+        "cache": cache.stats(),
+    }
+    for lane, lats in sorted(lat_by_lane.items()):
+        stats[f"{lane}_p50_ms"] = round(_percentile_ms(lats, 50), 3)
+        stats[f"{lane}_p99_ms"] = round(_percentile_ms(lats, 99), 3)
     return stats

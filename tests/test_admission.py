@@ -1,0 +1,620 @@
+"""Overload-safe serving (slate_tpu.serve.admission + the reworked queue):
+token-bucket and escalation-window math under an injected clock, lane
+ordering, deadline-ordered/early flush, SLO-verdict→shed transitions,
+typed rejection errors (QueueOverloadError / DeadlineExceededError),
+worker-death fail-fast, escalation caps, the serving chaos faults
+(slow_executor / worker_crash / cache_flush), and the slow-marked overload
+soak asserting the end-to-end contract."""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import slate_tpu as slate
+from slate_tpu import robust, serve
+from slate_tpu.core.exceptions import (DeadlineExceededError, NumericalError,
+                                       QueueOverloadError, SlateError)
+from slate_tpu.serve import admission
+from slate_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
+                                       EscalationBudget, TokenBucket,
+                                       shed_lanes_from_verdicts)
+from slate_tpu.serve.queue import BucketPolicy, _STAGE_BUCKETS
+
+
+def _dd(n, seed=0):
+    a = np.random.default_rng(seed).standard_normal((n, n)).astype(np.float32)
+    return a + n * np.eye(n, dtype=np.float32)
+
+
+def _rhs(n, nrhs=1, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, nrhs)).astype(np.float32)
+
+
+def _singular(n, seed=0, k=3):
+    a = _dd(n, seed)
+    a[:, k] = 0.0
+    a[k, :] = 0.0
+    return a
+
+
+class _Clock:
+    """Injected clock: tests advance it explicitly — no wall-time sleeps."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# token-bucket math (injected clock)
+
+
+class TestTokenBucket:
+    def test_starts_full_and_drains(self):
+        clk = _Clock()
+        tb = TokenBucket(rate=10.0, burst=3.0, clock=clk)
+        assert [tb.try_take() for _ in range(3)] == [True] * 3
+        assert not tb.try_take()
+
+    def test_refill_rate_is_exact(self):
+        clk = _Clock()
+        tb = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+        for _ in range(5):
+            assert tb.try_take()
+        assert not tb.try_take()
+        clk.advance(0.1)                       # exactly one token accrues
+        assert tb.try_take()
+        assert not tb.try_take()
+
+    def test_burst_caps_accrual(self):
+        clk = _Clock()
+        tb = TokenBucket(rate=100.0, burst=4.0, clock=clk)
+        clk.advance(1000.0)                    # long idle: still only burst
+        assert tb.tokens() == pytest.approx(4.0)
+        assert [tb.try_take() for _ in range(5)] == [True] * 4 + [False]
+
+    def test_retry_after_hint(self):
+        clk = _Clock()
+        tb = TokenBucket(rate=2.0, burst=1.0, clock=clk)
+        assert tb.try_take()
+        assert tb.retry_after_s() == pytest.approx(0.5)
+        clk.advance(0.25)
+        assert tb.retry_after_s() == pytest.approx(0.25)
+
+    def test_failed_take_does_not_debit(self):
+        clk = _Clock()
+        tb = TokenBucket(rate=1.0, burst=2.0, clock=clk)
+        assert not tb.try_take(5.0)
+        assert tb.tokens() == pytest.approx(2.0)
+
+    def test_rejects_nonpositive_config(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0, burst=1.0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1.0, burst=0.0)
+
+
+class TestEscalationBudget:
+    def test_window_cap_and_reset(self):
+        clk = _Clock()
+        eb = EscalationBudget(cap=3, window_s=1.0, clock=clk)
+        assert eb.take(2) == 2
+        assert eb.take(2) == 1                 # only 1 left this window
+        assert eb.take(1) == 0
+        clk.advance(1.0)                       # fresh window
+        assert eb.take(5) == 3
+
+    def test_zero_cap_blocks_everything(self):
+        eb = EscalationBudget(cap=0, window_s=1.0, clock=_Clock())
+        assert eb.take(10) == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO-verdict -> shed transitions
+
+
+def _verdict(name, verdict):
+    return SimpleNamespace(name=name, verdict=verdict)
+
+
+class TestShedTransitions:
+    def test_ok_sheds_nothing(self):
+        pol = AdmissionPolicy()
+        assert shed_lanes_from_verdicts(
+            [_verdict("gesv_p99_latency", "ok")], pol) == {}
+
+    def test_warning_sheds_best_effort(self):
+        pol = AdmissionPolicy()
+        shed = shed_lanes_from_verdicts(
+            [_verdict("gesv_p99_latency", "warning")], pol)
+        assert shed == {"best_effort": "slo_warning"}
+
+    def test_breach_sheds_below_protected_lane(self):
+        pol = AdmissionPolicy()        # unlisted SLOs protect interactive
+        shed = shed_lanes_from_verdicts(
+            [_verdict("gesv_p99_latency", "breach")], pol)
+        assert shed == {"batch": "slo_breach", "best_effort": "slo_breach"}
+
+    def test_breach_on_lower_lane_spares_the_upper(self):
+        pol = AdmissionPolicy(slo_lanes={"batch_p99": "batch"})
+        shed = shed_lanes_from_verdicts([_verdict("batch_p99", "breach")],
+                                        pol)
+        assert shed == {"best_effort": "slo_breach"}
+
+    def test_breach_reason_wins_over_warning(self):
+        pol = AdmissionPolicy()
+        shed = shed_lanes_from_verdicts(
+            [_verdict("a", "warning"), _verdict("b", "breach")], pol)
+        assert shed["best_effort"] == "slo_breach"
+
+    def test_controller_transitions_ok_warning_breach(self):
+        ctl = AdmissionController(AdmissionPolicy(), clock=_Clock())
+        ctl.consume_verdicts([_verdict("x", "ok")])
+        ctl.admit("best_effort", 0, 0)                 # admitted
+        ctl.consume_verdicts([_verdict("x", "warning")])
+        with pytest.raises(QueueOverloadError) as ei:
+            ctl.admit("best_effort", 0, 0)
+        assert ei.value.reason == "slo_warning"
+        ctl.admit("batch", 0, 0)                       # batch still open
+        ctl.consume_verdicts([_verdict("x", "breach")])
+        with pytest.raises(QueueOverloadError) as ei:
+            ctl.admit("batch", 0, 0)
+        assert ei.value.reason == "slo_breach"
+        ctl.admit("interactive", 0, 0)                 # protected lane open
+        ctl.consume_verdicts([_verdict("x", "ok")])    # recovery reopens
+        ctl.admit("best_effort", 0, 0)
+
+
+# ---------------------------------------------------------------------------
+# the admission decision
+
+
+class TestAdmissionController:
+    def test_depth_bound_with_structured_error(self):
+        ctl = AdmissionController(
+            AdmissionPolicy(max_depth={"best_effort": 2}, retry_after_s=0.25),
+            clock=_Clock())
+        ctl.admit("best_effort", 1, 10)
+        with pytest.raises(QueueOverloadError) as ei:
+            ctl.admit("best_effort", 2, 10)
+        e = ei.value
+        assert (e.lane, e.reason, e.depth) == ("best_effort", "depth", 2)
+        assert e.retry_after_s == pytest.approx(0.25)
+        assert isinstance(e, slate.SlateError)
+
+    def test_inflight_bound(self):
+        ctl = AdmissionController(AdmissionPolicy(max_in_flight=5),
+                                  clock=_Clock())
+        ctl.admit("interactive", 0, 4)
+        with pytest.raises(QueueOverloadError) as ei:
+            ctl.admit("interactive", 0, 5)
+        assert ei.value.reason == "inflight"
+
+    def test_rate_limit_with_retry_after(self):
+        clk = _Clock()
+        ctl = AdmissionController(
+            AdmissionPolicy(rate={"best_effort": 2.0},
+                            burst={"best_effort": 1.0}), clock=clk)
+        ctl.admit("best_effort", 0, 0)
+        with pytest.raises(QueueOverloadError) as ei:
+            ctl.admit("best_effort", 0, 0)
+        assert ei.value.reason == "rate"
+        assert ei.value.retry_after_s == pytest.approx(0.5)
+        ctl.admit("interactive", 0, 0)         # other lanes unlimited
+        clk.advance(0.5)
+        ctl.admit("best_effort", 0, 0)         # token accrued
+
+    def test_unknown_lane_rejected(self):
+        ctl = AdmissionController(clock=_Clock())
+        with pytest.raises(SlateError):
+            ctl.admit("vip", 0, 0)
+
+    def test_default_policy_admits_normal_traffic(self):
+        ctl = AdmissionController(clock=_Clock())
+        for lane in admission.LANES:
+            for d in (0, 100, 1000):
+                ctl.admit(lane, d, d)
+
+    def test_policy_rejects_unknown_lane_names_at_construction(self):
+        """A lane-name typo is a config bug, surfaced at construction —
+        never an overload verdict or a refresh-time crash."""
+        with pytest.raises(ValueError, match="unknown lane"):
+            AdmissionPolicy(max_depth={"interactiv": 2})
+        with pytest.raises(ValueError, match="unknown lane"):
+            AdmissionPolicy(rate={"vip": 1.0})
+        with pytest.raises(ValueError, match="unknown lane"):
+            AdmissionPolicy(slo_lanes={"p99": "interactiv"})
+        with pytest.raises(ValueError, match="unknown lane"):
+            AdmissionPolicy(shed_on_warning=("bestest_effort",))
+
+    def test_policy_rejects_degenerate_rate_config(self):
+        """rate<=0 and burst-without-rate would otherwise silently leave a
+        lane unlimited — rejected at construction instead."""
+        with pytest.raises(ValueError, match="rate must be positive"):
+            AdmissionPolicy(rate={"best_effort": 0.0})
+        with pytest.raises(ValueError, match="burst must be positive"):
+            AdmissionPolicy(rate={"best_effort": 1.0},
+                            burst={"best_effort": 0.0})
+        with pytest.raises(ValueError, match="without a matching rate"):
+            AdmissionPolicy(burst={"batch": 8.0})
+
+
+# ---------------------------------------------------------------------------
+# queue integration: lane ordering, deadline flush, expiry, typed errors
+
+
+class TestQueueLanes:
+    def test_ready_buckets_ordered_by_lane_priority(self):
+        q = serve.ServeQueue(start=False)
+        q.submit("gesv", _dd(8, 1), _rhs(8), lane="best_effort")
+        q.submit("gesv", _dd(24, 2), _rhs(24), lane="batch")
+        q.submit("gesv", _dd(13, 3), _rhs(13), lane="interactive")
+        ready = q._ready_keys(time.perf_counter() + 10.0)  # all past max_wait
+        lanes = [k[0] for k in ready]
+        assert lanes == ["interactive", "batch", "best_effort"]
+        q.close()
+
+    def test_same_lane_ordered_by_earliest_deadline(self):
+        q = serve.ServeQueue(start=False)
+        # distinct buckets (16 vs 32) in ONE lane; the later-submitted one
+        # carries the tighter deadline and must still flush first
+        q.submit("gesv", _dd(8, 1), _rhs(8), lane="batch", deadline=50.0)
+        q.submit("gesv", _dd(24, 2), _rhs(24), lane="batch", deadline=10.0)
+        ready = q._ready_keys(time.perf_counter() + 5.0)
+        assert [k[2][0] for k in ready] == [32, 16]
+        assert q._min_deadline[ready[0]] < q._min_deadline[ready[1]]
+        q.close()
+
+    def test_deadline_within_execute_p99_flushes_early(self):
+        from slate_tpu import obs
+
+        q = serve.ServeQueue(start=False)
+        # teach the p99 estimator this bucket "takes ~2s to execute"
+        obs.histogram("slate_serve_execute_seconds", "",
+                      buckets=_STAGE_BUCKETS).observe(
+                          2.0, routine="gesv", bucket="16x16x1")
+        t = q.submit("gesv", _dd(8, 1), _rhs(8), deadline=30.0)
+        now = time.perf_counter()
+        assert q._ready_keys(now) == []        # young bucket, budget ample
+        # 1s of budget left < the 2s observed p99 -> ready ahead of max_wait
+        near = t.t_deadline - 1.0
+        assert len(q._ready_keys(near)) == 1
+        q.close()
+
+    def test_lane_depth_accounting(self):
+        q = serve.ServeQueue(start=False)
+        for i in range(3):
+            q.submit("gesv", _dd(8, i), _rhs(8), lane="batch")
+        assert q.lane_depths() == {"batch": 3}
+        q.close()
+
+    def test_submit_validates_lane_and_deadline(self):
+        q = serve.ServeQueue(start=False)
+        with pytest.raises(SlateError):
+            q.submit("gesv", _dd(8), _rhs(8), lane="vip")
+        with pytest.raises(SlateError):
+            q.submit("gesv", _dd(8), _rhs(8), deadline=-1.0)
+        q.close()
+
+
+class TestQueueOverloadPaths:
+    def test_depth_shed_raises_typed_and_leaves_flight_record(self):
+        flight = serve.FlightRecorder(auto_dump_path="/dev/null")
+        q = serve.ServeQueue(
+            admission=AdmissionPolicy(max_depth={"best_effort": 1}),
+            start=False, flight=flight)
+        q.submit("gesv", _dd(8, 1), _rhs(8), lane="best_effort")
+        with pytest.raises(QueueOverloadError) as ei:
+            q.submit("gesv", _dd(8, 2), _rhs(8), lane="best_effort")
+        assert ei.value.lane == "best_effort" and ei.value.reason == "depth"
+        (rec,) = [r for r in flight.records() if r.reason == "shed"]
+        assert rec.lane == "best_effort"
+        assert "QueueOverloadError" in rec.error
+        from slate_tpu import obs
+
+        c = obs.REGISTRY.get("slate_serve_shed_total")
+        assert c is not None and c.value(lane="best_effort", reason="depth",
+                                         routine="gesv") >= 1.0
+        q.close()
+
+    def test_slo_coupled_shed_through_live_queue(self):
+        """The queue consumes its monitor's verdicts: a breach sheds the
+        lanes below the protected one, interactive stays admitted."""
+        from slate_tpu import obs
+
+        sampler = obs.TimeSeriesSampler(interval_s=1.0)
+        sampler.sample(now=0.0)
+        # fabricate a breach: many slow interactive-lane observations
+        h = obs.histogram("slate_serve_latency_seconds", "",
+                          buckets=_STAGE_BUCKETS)
+        for _ in range(100):
+            h.observe(50.0, routine="gesv", lane="interactive")
+        sampler.sample(now=1.0)
+        mon = obs.SLOMonitor([obs.SLO(
+            name="interactive_p99", kind="latency",
+            metric="slate_serve_latency_seconds",
+            labels=(("lane", "interactive"),), objective=0.5,
+            windows=100)], sampler)
+        q = serve.ServeQueue(start=False,
+                             admission=AdmissionPolicy(slo_refresh_s=0.0))
+        q.attach_slo(mon)
+        with pytest.raises(QueueOverloadError) as ei:
+            q.submit("gesv", _dd(8), _rhs(8), lane="batch")
+        assert ei.value.reason == "slo_breach"
+        with pytest.raises(QueueOverloadError):
+            q.submit("gesv", _dd(8), _rhs(8), lane="best_effort")
+        t = q.submit("gesv", _dd(8), _rhs(8), lane="interactive")
+        assert not t.done()                    # admitted, queued
+        q.close()
+
+    def test_deadline_expiry_resolves_typed_before_serving(self):
+        """A ticket queued behind a stalled executor expires with
+        DeadlineExceededError instead of wasting a batch slot."""
+        flight = serve.FlightRecorder(auto_dump_path="/dev/null")
+        q = serve.ServeQueue(flight=flight)
+        with robust.FaultPlan([robust.FaultSpec(
+                serve.SERVE_SITE, "slow_executor", call_index=0,
+                delay_s=0.4)]):
+            t_slow = q.submit("gesv", _dd(8, 1), _rhs(8))
+            time.sleep(0.05)               # worker pops + stalls on batch 0
+            t = q.submit("gesv", _dd(8, 2), _rhs(8), lane="best_effort",
+                         deadline=0.05)
+            assert t_slow.result(timeout=30.0)[1] == 0
+            with pytest.raises(DeadlineExceededError) as ei:
+                t.result(timeout=30.0)
+        e = ei.value
+        assert e.lane == "best_effort"
+        assert e.deadline_s == pytest.approx(0.05)
+        assert e.elapsed_s >= 0.05
+        (rec,) = [r for r in flight.records() if r.reason == "deadline"]
+        assert rec.lane == "best_effort" and rec.deadline_s == \
+            pytest.approx(0.05)
+        q.close()
+
+    def test_expiry_sweep_covers_every_lane(self):
+        """The per-cycle sweep pulls past-deadline tickets out of ALL
+        lanes — an expired best-effort ticket cannot wait behind sustained
+        higher-lane pops (deterministic: the sweep is called directly with
+        an explicit clock value)."""
+        q = serve.ServeQueue(start=False)
+        q.submit("gesv", _dd(8, 1), _rhs(8), lane="interactive")
+        t = q.submit("gesv", _dd(24, 2), _rhs(24), lane="best_effort",
+                     deadline=0.05)
+        with q._cv:
+            swept = q._sweep_expired_locked(t.t_deadline + 1.0)
+        assert [it.ticket for _, it in swept] == [t]
+        assert q.lane_depths() == {"interactive": 1}   # untouched lane
+        # the swept ticket resolves through the normal expiry path
+        q._expire(*swept[0])
+        with pytest.raises(DeadlineExceededError):
+            t.result(timeout=0)
+        q.close()
+
+    def test_submit_after_close_raises_immediately(self):
+        q = serve.ServeQueue()
+        q.close()
+        t0 = time.perf_counter()
+        with pytest.raises(SlateError, match="closed"):
+            q.submit("gesv", _dd(8), _rhs(8))
+        assert time.perf_counter() - t0 < 5.0  # raised, not hung-to-timeout
+
+    def test_worker_death_fails_tickets_fast_and_blocks_submit(self):
+        flight = serve.FlightRecorder(auto_dump_path="/dev/null")
+        q = serve.ServeQueue(flight=flight)
+        with robust.FaultPlan([robust.FaultSpec(serve.SERVE_SITE,
+                                                "worker_crash")]):
+            t = q.submit("gesv", _dd(8), _rhs(8))
+            with pytest.raises(SlateError, match="worker thread died"):
+                t.result(timeout=30.0)
+        # queued-after-death must raise at submit, not hang at result
+        with pytest.raises(SlateError, match="died"):
+            q.submit("gesv", _dd(8, 2), _rhs(8))
+        recs = [r for r in flight.records() if r.reason == "worker_death"]
+        assert recs and all("worker crash" in r.error for r in recs)
+        from slate_tpu import obs
+
+        c = obs.REGISTRY.get("slate_serve_worker_deaths_total")
+        assert c is not None and sum(c.series().values()) >= 1
+        q.close()
+
+    def test_escalation_cap_resolves_typed_error(self):
+        """With a zero escalation budget, a failed element resolves with
+        its typed numerical error (no ladder re-run); siblings are
+        unaffected."""
+        q = serve.ServeQueue(
+            admission=AdmissionPolicy(max_escalations_per_window=0))
+        t_bad = q.submit("gesv", _singular(8), _rhs(8))
+        t_ok = q.submit("gesv", _dd(8, 5), _rhs(8))
+        with pytest.raises(NumericalError):
+            t_bad.result(timeout=60.0)
+        assert t_ok.result(timeout=60.0)[1] == 0
+        from slate_tpu import obs
+
+        c = obs.REGISTRY.get("slate_serve_escalations_capped_total")
+        assert c is not None and sum(c.series().values()) >= 1
+        q.close()
+
+    def test_ghost_pad_slots_do_not_burn_escalation_budget(self):
+        """Batch-axis round-up ghosts are identity systems, not copies of
+        the last request — a failing LAST element is capped/escalated once,
+        not once per ghost slot."""
+        from slate_tpu import obs
+
+        c = obs.REGISTRY.get("slate_serve_escalations_capped_total")
+        before = sum(c.series().values()) if c is not None else 0.0
+        q = serve.ServeQueue(
+            admission=AdmissionPolicy(max_escalations_per_window=0))
+        t_ok = q.submit("gesv", _dd(8, 5), _rhs(8))
+        t_bad = q.submit("gesv", _singular(8), _rhs(8))  # last -> padded
+        with pytest.raises(NumericalError):
+            t_bad.result(timeout=60.0)
+        assert t_ok.result(timeout=60.0)[1] == 0
+        q.close()
+        c = obs.REGISTRY.get("slate_serve_escalations_capped_total")
+        # exactly ONE capped element: the singular request itself — the
+        # round_batch(2)=4 ghost slots must not replicate its failure
+        assert sum(c.series().values()) - before == 1.0
+
+    def test_capped_report_recovered_stays_false(self):
+        """A budget-capped element's SolveReport keeps recovered=False
+        through finalize (the report and the ticket's typed error must
+        agree)."""
+        prev = serve.set_escalation_gate(lambda n: 0)
+        try:
+            a = np.stack([_dd(8, 1), _singular(8)])
+            b = np.stack([_rhs(8), _rhs(8)])
+            x, perm, info, reports = serve.gesv_batched(
+                a, b, opts={"solve_report": True,
+                            "use_fallback_solver": True})
+        finally:
+            serve.set_escalation_gate(prev)
+        assert int(np.asarray(info)[1]) != 0
+        assert reports[0].recovered is True
+        assert reports[1].recovered is False
+        assert reports[1].fallback_chain == ("batched",)
+
+    def test_escalation_budget_allows_within_cap(self):
+        """Default budget: the same singular element escalates (ladder
+        runs) and resolves best-effort with nonzero info — the pre-PR
+        behavior is preserved when the budget has room."""
+        q = serve.ServeQueue()
+        t = q.submit("gesv", _singular(8), _rhs(8))
+        x, info = t.result(timeout=60.0)       # ladder ran; LAPACK semantics
+        assert info != 0
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# serving chaos faults
+
+
+class TestServingFaults:
+    def test_slow_executor_deterministic_delay(self):
+        plan = robust.FaultPlan([robust.FaultSpec(
+            serve.SERVE_SITE, "slow_executor", call_index=0, delay_s=0.2)])
+        reqs = [("gesv", _dd(8, i), _rhs(8)) for i in range(2)]
+        cache = serve.ExecutableCache()
+        serve.solve_many(reqs, cache=cache)    # warm outside the plan
+        with plan:
+            t0 = time.perf_counter()
+            serve.solve_many(reqs, cache=cache)
+            assert time.perf_counter() - t0 >= 0.2
+        assert plan.fired == ((serve.SERVE_SITE, "slow_executor", 0),)
+
+    def test_cache_flush_forces_recompile_keeps_stats(self):
+        cache = serve.ExecutableCache()
+        reqs = [("gesv", _dd(8, i), _rhs(8)) for i in range(2)]
+        serve.solve_many(reqs, cache=cache)
+        warm_misses = cache.stats()["misses"]
+        with robust.FaultPlan([robust.FaultSpec(serve.SERVE_SITE,
+                                                "cache_flush")]):
+            serve.solve_many(reqs, cache=cache)
+        assert cache.stats()["misses"] == warm_misses + 1  # recompiled once
+        assert plan_replays_identically()
+
+    def test_worker_crash_call_index_targets_nth_batch(self):
+        """call_index addresses the Nth batch at the serve site, like the
+        numerical faults address the Nth driver call."""
+        plan = robust.FaultPlan([robust.FaultSpec(
+            serve.SERVE_SITE, "worker_crash", call_index=1)])
+        cache = serve.ExecutableCache()
+        r0 = [("gesv", _dd(8, 1), _rhs(8))]
+        r1 = [("posv", (_dd(8, 2) @ _dd(8, 2).T +
+                        8 * np.eye(8)).astype(np.float32), _rhs(8))]
+        serve.solve_many(r0 + r1, cache=cache)           # warm, no plan
+        with plan:
+            serve.solve_many(r0, cache=cache)            # call 0: clean
+            with pytest.raises(RuntimeError, match="injected worker crash"):
+                serve.solve_many(r1, cache=cache)        # call 1: crash
+        assert plan.fired == ((serve.SERVE_SITE, "worker_crash", 1),)
+
+
+def plan_replays_identically():
+    """Replay contract for the serve faults: re-entering the same plan
+    fires the same (site, kind, call) triples."""
+    plan = robust.FaultPlan([robust.FaultSpec(
+        serve.SERVE_SITE, "cache_flush", call_index=0)])
+    cache = serve.ExecutableCache()
+    reqs = [("gesv", _dd(8, 7), _rhs(8))]
+    serve.solve_many(reqs, cache=cache)
+    fired = []
+    for _ in range(2):
+        with plan:
+            serve.solve_many(reqs, cache=cache)
+        fired.append(plan.fired)
+    return fired[0] == fired[1] == ((serve.SERVE_SITE, "cache_flush", 0),)
+
+
+# ---------------------------------------------------------------------------
+# exports + error taxonomy
+
+
+class TestTaxonomy:
+    def test_exports(self):
+        assert serve.QueueOverloadError is QueueOverloadError
+        assert serve.DeadlineExceededError is DeadlineExceededError
+        assert slate.QueueOverloadError is QueueOverloadError
+        assert issubclass(QueueOverloadError, slate.SlateError)
+        assert issubclass(DeadlineExceededError, slate.SlateError)
+
+    def test_structured_fields_and_messages(self):
+        e = QueueOverloadError(lane="batch", depth=7, reason="depth",
+                               retry_after_s=0.5)
+        assert "batch" in str(e) and e.depth == 7
+        d = DeadlineExceededError(lane="interactive", deadline_s=0.25,
+                                  elapsed_s=0.3)
+        assert d.deadline_s == 0.25 and "0.25" in str(d)
+
+
+# ---------------------------------------------------------------------------
+# the overload soak (slow: wall-clock arrival process by construction)
+
+
+@pytest.mark.slow
+class TestOverloadSoak:
+    def test_overload_contract_end_to_end(self):
+        from slate_tpu import obs
+
+        flight = serve.FlightRecorder(capacity=50_000,
+                                      auto_dump_path="/dev/null")
+        sampler = obs.TimeSeriesSampler(interval_s=0.25)
+        box = {}
+
+        def after_warmup(q):
+            sampler.start()
+            box["mon"] = obs.SLOMonitor([obs.SLO(
+                name="interactive_p99_latency", kind="latency",
+                metric="slate_serve_latency_seconds",
+                labels=(("lane", "interactive"),), objective=2.5,
+                windows=10_000)], sampler)
+            q.attach_slo(box["mon"])
+
+        stats = serve.run_overload_workload(
+            duration_s=8.0, seed=0, flight=flight,
+            after_warmup=after_warmup)
+        sampler.stop()
+        (v,) = box["mon"].evaluate()
+
+        # interactive survives: p99 SLO non-breach at >= 2x capacity
+        assert stats["offered_rate"] >= 1.5 * stats[
+            "capacity_solves_per_sec"]
+        assert v.verdict in ("ok", "warning"), v.detail
+        # shedding lands on the right lane, with typed errors
+        be = stats["submitted_by_lane"]["best_effort"]
+        assert stats["shed_by_lane"].get("best_effort", 0) >= 0.01 * be
+        assert stats["shed_by_lane"].get("interactive", 0) == 0
+        # zero hung tickets; everything resolved exactly once
+        assert stats["hung"] == 0
+        assert stats["worker_failed"] == 0
+        # every rejection has a flight record with the matching reason
+        shed_recs = [r for r in flight.records() if r.reason == "shed"]
+        assert len(shed_recs) >= stats["shed"]
+        assert all("QueueOverloadError" in r.error for r in shed_recs)

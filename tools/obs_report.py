@@ -12,8 +12,9 @@ Inputs (all JSON documents written by the obs layer):
 
 Output: one markdown report — per-routine stage-latency decomposition
 (queue-wait vs execute vs pad, p50/p99 from the histogram buckets), window
-request/batch/error rates, the SLO verdict table, and the flight-recorder
-summary.  The CI serving-smoke step writes it next to the artifacts it
+request/batch/error rates, the SLO verdict table, the rejection breakdown
+(shed / deadline-expired / worker-failed requests grouped by reason and
+lane), and the flight-recorder summary.  The CI serving-smoke step writes it next to the artifacts it
 renders; ``render_report`` is importable so the smoke gates on the same
 numbers it publishes.
 """
@@ -166,6 +167,34 @@ def _slo_table(ts_doc: Dict[str, Any]) -> List[str]:
     return lines + [""]
 
 
+def _rejection_table(flight_doc: Optional[Dict[str, Any]]) -> List[str]:
+    """Rejection breakdown: every flight record carrying a ``reason``
+    (shed / deadline / worker_error / worker_death), grouped by
+    (reason, lane) — the "where did the shed land" table the overload
+    contract is audited against."""
+    if flight_doc is None:
+        return ["_no flight-recorder dump supplied_", ""]
+    recs = [r for r in flight_doc.get("records", []) if r.get("reason")]
+    if not recs:
+        return ["_no rejected/expired requests in the ring_", ""]
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for r in recs:
+        groups.setdefault((r["reason"], r.get("lane") or "?"), []).append(r)
+    lines = ["| reason | lane | count | routines | example trace id |",
+             "|---|---|---|---|---|"]
+    for (reason, lane), rs in sorted(groups.items()):
+        routines = ",".join(sorted({r["routine"] for r in rs}))
+        lines.append(f"| `{reason}` | `{lane}` | {len(rs)} | {routines} "
+                     f"| `{rs[-1]['trace_id']}` |")
+    lines.append("")
+    lines.append(f"({len(recs)} rejected/expired records of "
+                 f"{len(flight_doc.get('records', []))} in the ring; every "
+                 "rejection leaves a record with its reason — `shed` = "
+                 "admission control, `deadline` = in-queue expiry, "
+                 "`worker_error`/`worker_death` = executor failure)")
+    return lines + [""]
+
+
 def _flight_section(flight_doc: Optional[Dict[str, Any]]) -> List[str]:
     if flight_doc is None:
         return ["_no flight-recorder dump supplied_", ""]
@@ -212,6 +241,7 @@ def render_report(ts_doc: Dict[str, Any],
     else:
         md += ["_no metrics.json supplied_", ""]
     md += ["## Window rates", "", *_window_table(ts_doc),
+           "## Rejection breakdown", "", *_rejection_table(flight_doc),
            "## Flight recorder", "", *_flight_section(flight_doc)]
     return "\n".join(md).rstrip() + "\n"
 

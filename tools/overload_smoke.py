@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""CI overload-smoke: a short seeded overload run on CPU, gating on the
+overload-survival contract (ISSUE 15 / ROADMAP 2(c)).
+
+The serving queue is driven at ~2x its *measured* capacity with
+heavy-tailed arrivals across the three priority lanes (seeded, CPU-only,
+~60 s wall).  Gates (the ci.yml ``overload-smoke`` step fails on any):
+
+* the interactive-lane p99 latency SLO evaluates NON-BREACH under overload
+  (the whole point of lanes + shedding: interactive traffic survives),
+* load shedding actually happened and landed on the right lane: >= 1% of
+  offered best-effort traffic rejected with ``QueueOverloadError``, and
+  ZERO interactive submissions shed at the calibrated policy,
+* deadline machinery leaves evidence: ``slate_serve_deadline_expired_total``
+  present (a deterministic expiry scenario guarantees the counter exists
+  even on a fast runner),
+* ``slate_serve_shed_total`` present and the whole registry schema-valid,
+* zero unresolved tickets — every admitted request resolved (value or
+  typed error); nothing hung past the drain,
+* every rejected/expired request in the flight ring carries its matching
+  ``reason`` (``shed`` / ``deadline``), and OBS_REPORT.md renders the
+  rejection-breakdown table.
+
+Artifacts: ``overload_metrics.json``, ``overload_timeseries.json``,
+``overload_flight.json``, ``OVERLOAD_REPORT.md``.  Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from force_cpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+DURATION_S = 20.0
+INTERACTIVE_P99_S = 2.5        # generous for CI runners; the lane contract
+MIN_BEST_EFFORT_SHED = 0.01    # >= 1% of offered best-effort traffic
+
+
+def main() -> int:
+    import numpy as np
+
+    from slate_tpu import obs, serve
+    from slate_tpu.core.exceptions import DeadlineExceededError
+
+    import obs_report
+
+    flight = serve.FlightRecorder(capacity=50_000, auto_dump_path="/dev/null")
+    sampler = obs.TimeSeriesSampler(interval_s=0.25)
+    monitor_box = {}
+
+    def after_warmup(q):
+        sampler.start()
+        monitor_box["monitor"] = obs.SLOMonitor([obs.SLO(
+            name="interactive_p99_latency", kind="latency",
+            metric="slate_serve_latency_seconds",
+            labels=(("lane", "interactive"),),
+            objective=INTERACTIVE_P99_S, target=0.99, windows=10_000)],
+            sampler)
+        q.attach_slo(monitor_box["monitor"])
+
+    stats = serve.run_overload_workload(
+        duration_s=DURATION_S, seed=0, flight=flight,
+        after_warmup=after_warmup)
+
+    # deterministic deadline-expiry scenario: the counter must exist even if
+    # the overload pass's best-effort traffic happened to beat its budgets.
+    # A slow_executor chaos fault stalls the worker on an interactive batch
+    # long past the best-effort ticket's budget, so the expiry is certain.
+    from slate_tpu import robust
+
+    q = serve.ServeQueue(flight=flight)
+    a = np.eye(8, dtype=np.float32) * 8
+    b = np.ones((8, 1), np.float32)
+    expired_typed = False
+    with robust.FaultPlan([robust.FaultSpec(
+            serve.SERVE_SITE, "slow_executor", call_index=0, delay_s=0.5)]):
+        t_slow = q.submit("gesv", a, b)                 # stalls the worker
+        time.sleep(0.05)                                # let it get popped
+        t = q.submit("gesv", a, b, lane="best_effort", deadline=0.05)
+        t_slow.result(timeout=30.0)
+        try:
+            t.result(timeout=30.0)
+        except DeadlineExceededError:
+            expired_typed = True
+    q.close()
+
+    sampler.stop()
+    verdicts = monitor_box["monitor"].evaluate()
+
+    failures = []
+    # -- the lane contract ---------------------------------------------------
+    (iv,) = [v for v in verdicts if v.name == "interactive_p99_latency"]
+    if iv.verdict == "breach":
+        failures.append(f"interactive p99 SLO BREACH under overload "
+                        f"({iv.detail})")
+    if iv.verdict == "no_data":
+        failures.append("interactive p99 SLO has no data — lane label "
+                        "missing from the latency histogram?")
+    be_offered = stats["submitted_by_lane"].get("best_effort", 0)
+    be_shed = stats["shed_by_lane"].get("best_effort", 0)
+    if be_offered == 0:
+        failures.append("no best-effort traffic offered")
+    elif be_shed < MIN_BEST_EFFORT_SHED * be_offered:
+        failures.append(f"best-effort shed {be_shed}/{be_offered} — "
+                        "under the 1% overload floor; shedding not engaging")
+    if stats["shed_by_lane"].get("interactive", 0):
+        failures.append(f"{stats['shed_by_lane']['interactive']} interactive "
+                        "requests shed — the ladder landed on the WRONG lane")
+    if stats["hung"]:
+        failures.append(f"{stats['hung']} tickets unresolved after drain")
+    if stats["worker_failed"]:
+        failures.append(f"{stats['worker_failed']} requests died on "
+                        "unexpected worker errors")
+    if not expired_typed:
+        failures.append("deterministic deadline scenario did not raise "
+                        "DeadlineExceededError")
+
+    # -- counters + schema ---------------------------------------------------
+    doc = obs.metrics_doc(source="overload-smoke")
+    try:
+        obs.validate_metrics(doc)
+    except ValueError as e:
+        failures.append(f"metrics schema violation: {e}")
+    names = {m["name"] for m in doc["metrics"]}
+    for need in ("slate_serve_shed_total",
+                 "slate_serve_deadline_expired_total",
+                 "slate_serve_lane_depth"):
+        if need not in names:
+            failures.append(f"metric {need} missing from the registry")
+    obs.export_metrics("overload_metrics.json", source="overload-smoke")
+
+    # -- flight evidence -----------------------------------------------------
+    recs = flight.records()
+    shed_recs = [r for r in recs if r.reason == "shed"]
+    reg_shed = stats["shed"]
+    if len(shed_recs) < reg_shed:
+        failures.append(f"only {len(shed_recs)} shed flight records for "
+                        f"{reg_shed} rejections — rejections without "
+                        "evidence")
+    for r in shed_recs[:50]:
+        if "QueueOverloadError" not in (r.error or ""):
+            failures.append(f"shed record {r.trace_id} lacks the typed "
+                            f"error: {r.error!r}")
+            break
+    if not any(r.reason == "deadline" for r in recs):
+        failures.append("no deadline flight record despite the "
+                        "deterministic expiry")
+
+    ts_path = sampler.export("overload_timeseries.json",
+                             source="overload-smoke",
+                             slos=[v.to_dict() for v in verdicts])
+    ts_doc = json.load(open(ts_path))
+    try:
+        obs.validate_timeseries(ts_doc)
+    except ValueError as e:
+        failures.append(f"timeseries schema violation: {e}")
+    flight_path = flight.dump("overload_flight.json")
+    report = obs_report.render_report(ts_doc, doc,
+                                      json.load(open(flight_path)))
+    with open("OVERLOAD_REPORT.md", "w") as f:
+        f.write(report)
+    if "## Rejection breakdown" not in report or "| `shed` |" not in report:
+        failures.append("OVERLOAD_REPORT.md missing the rejection-"
+                        "breakdown table")
+
+    print(json.dumps({
+        "ok": not failures,
+        "capacity_solves_per_sec": stats["capacity_solves_per_sec"],
+        "offered_rate": stats["offered_rate"],
+        "admitted": stats["admitted"], "ok_requests": stats["ok"],
+        "shed_by_lane": stats["shed_by_lane"],
+        "shed_reasons": stats["shed_reasons"],
+        "expired": stats["expired"], "hung": stats["hung"],
+        "interactive_p99_ms": stats.get("interactive_p99_ms"),
+        "best_effort_p99_ms": stats.get("best_effort_p99_ms"),
+        "slo": {v.name: v.verdict for v in verdicts},
+        "artifacts": ["overload_metrics.json", "overload_timeseries.json",
+                      "overload_flight.json", "OVERLOAD_REPORT.md"],
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
