@@ -101,6 +101,12 @@ BASELINES = {
                               # the serving axis measures throughput + p50/
                               # p99 latency of the slate_tpu.serve queue
                               # under synthetic mixed traffic (ROADMAP 2)
+    "serve_scale": 40000.0,   # solves/s — the serve_mixed denominator x2:
+                              # the scale axis reports the N=2 executor-pool
+                              # warm rate, so its trend line is read against
+                              # a two-worker batched-cuSOLVER-class figure.
+                              # Unit is warm solves/s at N=2 (scaling gates
+                              # — N=2 >= N=1 — ride in the metrics blob)
 }
 
 # ordered safest-first: a child killed mid-execution can wedge the
@@ -108,9 +114,9 @@ BASELINES = {
 # cheap/robust on hardware run before the risky ones (LU last: both the fused
 # and tournament paths are slow enough at n=16384 to risk the per-config
 # timeout)
-CONFIGS = ["gemm", "norm", "serve_mixed", "f64gemm", "potrf", "potrf_la",
-           "gels", "gesvir", "heev", "svd", "getrf", "getrf_pp", "heev2s",
-           "svd2s"]
+CONFIGS = ["gemm", "norm", "serve_mixed", "serve_scale", "f64gemm", "potrf",
+           "potrf_la", "gels", "gesvir", "heev", "svd", "getrf", "getrf_pp",
+           "heev2s", "svd2s"]
 HEADLINE = "gemm"
 
 # per-config child timeouts: the BASELINE-scale eig/SVD configs and the
@@ -755,9 +761,34 @@ def child_serve_mixed(cpu_fallback):
            "cache": stats["cache"], "warmup": stats["warmup"]})
 
 
+def child_serve_scale(cpu_fallback):
+    """Executor-pool scaling axis (multi-executor serving data path): the
+    same warm mixed-traffic protocol as serve_mixed run at pool sizes
+    N in {1, 2, 4} on one host.  Headline value is the N=2 warm rate
+    (scored against the 2x serve_mixed denominator); the N=1/N=4 rates
+    and the N2/N1 speedup ride along so regressions in routing, stealing,
+    or the dispatch/resolve overlap show up as a trend break even when
+    the absolute rate moves with the host."""
+    from slate_tpu.serve.workload import run_scale_workload
+
+    out = run_scale_workload(executor_counts=(1, 2, 4), num_requests=900,
+                             seed=0)
+    sps = out["solves_per_sec"]
+    runs = out["runs"]
+    _emit({"metric": "serve_scale_n2_solves_per_sec",
+           "value": sps["2"], "unit": "solves/s",
+           "solves_per_sec": sps,
+           "n2_over_n1": round(sps["2"] / max(sps["1"], 1e-9), 3),
+           "steals": {n: runs[n].get("steals", 0) for n in runs},
+           "misses_after_warmup": {
+               n: runs[n].get("misses_after_warmup") for n in runs},
+           "p99_ms": {n: runs[n].get("p99_ms") for n in runs}})
+
+
 CHILDREN = {
     "probe": lambda cpu: child_probe(),
     "serve_mixed": child_serve_mixed,
+    "serve_scale": child_serve_scale,
     "norm": child_norm,
     "gemm": child_gemm,
     "potrf": child_potrf,

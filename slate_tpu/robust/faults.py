@@ -121,6 +121,12 @@ class FaultSpec:
                 — a guaranteed stall at the default tolerance).
     delay_s:    stall duration for ``slow_executor`` (exact, deterministic —
                 the chaos clock is the plan, not a RNG).
+    executor:   serving-fault targeting: None (default) counts the site's
+                GLOBAL batch calls — pool-agnostic, exactly the pre-pool
+                behavior; an int pins the fault to that executor's OWN
+                call sequence (``executor=1, call_index=2`` kills executor
+                1's third batch no matter how the pool interleaves), so
+                chaos can exercise drain-and-reroute instead of fail-all.
     """
 
     driver: str
@@ -132,6 +138,7 @@ class FaultSpec:
     world: int = 8
     scale: float = 1e3
     delay_s: float = 0.05
+    executor: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in _KIND_POINT:
@@ -194,9 +201,30 @@ class FaultPlan:
         self._counts[(driver, point)] = idx + 1
         hits = [s for s in self.specs
                 if s.driver == driver and s.point == point
-                and s.call_index == idx]
+                and s.executor is None and s.call_index == idx]
         for s in hits:
             self._fired.append((driver, s.kind, idx))
+        return hits
+
+    def _take_serve(self, site: str,
+                    executor: Optional[int] = None) -> List[FaultSpec]:
+        """Serve-point call accounting: the global (site, serve) counter
+        always advances (executor-agnostic specs replay exactly as before
+        the pool existed), and when the caller identifies itself as an
+        executor, that executor's OWN counter advances too — an
+        ``executor=k`` spec counts only executor k's batches, so it fires
+        deterministically however the pool interleaves."""
+        hits = self._take(site, POINT_SERVE)
+        if executor is not None:
+            ekey = (site, POINT_SERVE, int(executor))
+            eidx = self._counts.get(ekey, 0)
+            self._counts[ekey] = eidx + 1
+            mine = [s for s in self.specs
+                    if s.driver == site and s.point == POINT_SERVE
+                    and s.executor == int(executor) and s.call_index == eidx]
+            for s in mine:
+                self._fired.append((site, s.kind, eidx))
+            hits = hits + mine
         return hits
 
 
@@ -268,21 +296,26 @@ def inject(driver: str, x, point: str = POINT_INPUT):
     return x
 
 
-def inject_serve(site: str) -> List[FaultSpec]:
+def inject_serve(site: str, executor: Optional[int] = None
+                 ) -> List[FaultSpec]:
     """Serving-level injection boundary: which serve faults fire at this
     (site, call) point of the active plan.
 
     Unlike :func:`inject` — a pure array→array transform — serving faults
     are host-side *events* (a stall, a crash, a cache wipe), so this hook
     returns the fired specs and the serving layer acts on them
-    (``slate_tpu.serve.queue`` sleeps / raises / clears the cache).  Same
-    call accounting as the numerical faults: ``call_index`` counts batch
-    executions at ``site``, so a ``worker_crash`` at call 2 kills the third
-    batch deterministically.  Zero-overhead with no plan active."""
+    (``slate_tpu.serve.executor`` sleeps / raises / clears the cache).
+    Same call accounting as the numerical faults: ``call_index`` counts
+    batch executions at ``site``, so a ``worker_crash`` at call 2 kills the
+    third batch deterministically.  ``executor`` identifies the calling
+    pool executor: specs with a matching ``FaultSpec.executor`` count that
+    executor's batches alone (drain-and-reroute chaos); executor-less
+    specs keep counting the global sequence.  Zero-overhead with no plan
+    active."""
     plan = active()
     if plan is None:
         return []
-    specs = plan._take(site, POINT_SERVE)
+    specs = plan._take_serve(site, executor)
     for spec in specs:
         trace_event("fault_inject", driver=site, kind=spec.kind,
                     point=POINT_SERVE, call=spec.call_index)

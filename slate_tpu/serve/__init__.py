@@ -38,22 +38,26 @@ from ..core.exceptions import DeadlineExceededError, QueueOverloadError
 from .admission import (AdmissionController, AdmissionPolicy, DEFAULT_LANE,
                         EscalationBudget, LANES, TokenBucket,
                         shed_lanes_from_verdicts)
-from .batched import (gels_batched, gesv_batched, last_escalations,
-                      posv_batched, set_escalation_gate)
+from .batched import (PendingBatch, finish_batched, gels_batched,
+                      gesv_batched, last_escalations, posv_batched,
+                      set_escalation_gate, start_batched)
 from .cache import ExecutableCache, default_cache, reset_cache
+from .executor import Chunk, Executor, ExecutorPool, executable_key
 from .flight import FlightRecord, FlightRecorder, validate_flight
 from .queue import (BucketPolicy, SERVE_SITE, ServeQueue, Ticket,
                     pad_request, solve_many, unpad_result)
-from .workload import make_requests, run_mixed_workload, run_overload_workload
+from .workload import (make_requests, run_mixed_workload,
+                       run_overload_workload, run_scale_workload)
 
 __all__ = [
     "gesv_batched", "posv_batched", "gels_batched", "last_escalations",
-    "set_escalation_gate",
+    "set_escalation_gate", "start_batched", "finish_batched", "PendingBatch",
     "ExecutableCache", "default_cache", "reset_cache",
+    "Executor", "ExecutorPool", "Chunk", "executable_key",
     "FlightRecord", "FlightRecorder", "validate_flight",
     "BucketPolicy", "ServeQueue", "Ticket", "pad_request", "unpad_result",
     "solve_many", "make_requests", "run_mixed_workload",
-    "run_overload_workload",
+    "run_overload_workload", "run_scale_workload",
     "AdmissionController", "AdmissionPolicy", "DEFAULT_LANE",
     "EscalationBudget", "LANES", "TokenBucket", "shed_lanes_from_verdicts",
     "QueueOverloadError", "DeadlineExceededError", "SERVE_SITE",

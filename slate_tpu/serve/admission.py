@@ -102,6 +102,18 @@ class TokenBucket:
         """Seconds until ``n`` tokens will be available (0 if already)."""
         return max(n - self.tokens(now), 0.0) / self.rate
 
+    def set_rate(self, rate: float, now: Optional[float] = None) -> None:
+        """Re-rate the bucket in place (capacity recalibration when the
+        executor pool shrinks/grows).  The elapsed window refills at the
+        OLD rate first, so the switch is exact, not retroactive; banked
+        tokens above the (unchanged) burst cap are kept until spent."""
+        if rate <= 0:
+            raise ValueError(f"token bucket rate must stay positive, "
+                             f"got {rate}")
+        with self._lock:
+            self._refill(self._clock() if now is None else float(now))
+            self.rate = float(rate)
+
 
 class EscalationBudget:
     """Fixed-window cap on escalation-ladder re-runs.
@@ -252,6 +264,29 @@ class AdmissionController:
         self._shed: Dict[str, str] = {}
         self._shed_t = float("-inf")
         self._lock = threading.Lock()
+        # calibration-time rates: scale_capacity re-rates the live buckets
+        # from these, so repeated rescales never compound
+        self._base_rates = dict(self.policy.rate)
+        self.capacity_fraction = 1.0
+
+    def scale_capacity(self, fraction: float) -> None:
+        """Re-key every token bucket off *surviving* capacity.
+
+        The serving queue calls this when its executor pool changes size
+        mid-run (an executor died, capacity shrank): each lane's bucket is
+        re-rated to ``fraction`` x its calibration-time rate, so admission
+        keeps shedding at the rate the SURVIVORS can actually serve — not
+        the rate the full pool was calibrated for.  Idempotent per
+        fraction; rescales never compound."""
+        if not 0.0 < fraction:
+            raise ValueError(f"capacity fraction must be positive, "
+                             f"got {fraction}")
+        with self._lock:
+            self.capacity_fraction = float(fraction)
+        for lane, base in self._base_rates.items():
+            bucket = self._buckets.get(lane)
+            if bucket is not None:
+                bucket.set_rate(max(base * fraction, 1e-9))
 
     # -- the SLO coupling ----------------------------------------------------
     def consume_verdicts(self, verdicts: Sequence) -> Dict[str, str]:

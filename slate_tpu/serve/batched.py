@@ -210,12 +210,38 @@ def _escalate(routine: str, core: Callable, a0, b, idx: Sequence[int],
     return out_arrays, info
 
 
-def _solve_batched(routine: str, A, B, opts, cache, donate):
-    """Shared driver body; returns (payload tuple, info[, reports])."""
-    _tl.escalations = {}                 # fresh side channel for this call
+class PendingBatch:
+    """An in-flight batched solve: :func:`start_batched`'s async handle.
+
+    Holds everything :func:`finish_batched` needs to sync the device
+    result and run the verdict/escalation half — the pristine operands
+    (``a0`` for ladder re-runs), the raw driver output (async JAX arrays;
+    dispatch has returned but the device may still be computing), and the
+    option/verdict flags decided at dispatch time.  The serving executors
+    (:mod:`.executor`) hand these between their dispatch and resolve
+    threads so host-side padding of batch k+1 overlaps device execution of
+    batch k."""
+
+    __slots__ = ("routine", "B", "a0", "b", "squeeze", "opts", "out",
+                 "want_verdict")
+
+    def __init__(self, routine, B, a0, b, squeeze, opts, out, want_verdict):
+        self.routine, self.B = routine, B
+        self.a0, self.b, self.squeeze = a0, b, squeeze
+        self.opts, self.out, self.want_verdict = opts, out, want_verdict
+
+
+def start_batched(routine: str, A, B, opts=None, cache=None,
+                  donate: bool = False) -> PendingBatch:
+    """Dispatch half of a batched solve: validate, inject, and enqueue the
+    async device call — NO host sync.  Returns a :class:`PendingBatch` for
+    :func:`finish_batched`; until then the device computes in the
+    background (JAX async dispatch), which is the overlap the executor
+    pool's split data path is built on.  The executable-cache lookup
+    happens here, on the calling thread (``cache.last_lookup()`` is
+    thread-local — probe it before handing off)."""
     opts = Options.make(opts)
     a0, b, squeeze = _as_batch(A, B, routine)
-    batch = a0.shape[0]
     a = _inject_each(routine, a0)
     want_verdict = (opts.use_fallback_solver or opts.solve_report
                     or active() is not None)
@@ -224,7 +250,22 @@ def _solve_batched(routine: str, A, B, opts, cache, donate):
     # the zero-sync fast path where nothing is read back after execution
     out = _run_batched(routine, a, b, opts, cache,
                        donate and not want_verdict)
-    payload, info = list(out[:-1]), out[-1]
+    return PendingBatch(routine, B, a0, b, squeeze, opts, out, want_verdict)
+
+
+def finish_batched(pb: PendingBatch):
+    """Resolve half: host-sync the verdict, run element-granular
+    escalation, finalize reports — returns ``(payload list, info[,
+    reports])`` exactly like the one-shot drivers.  Runs on whichever
+    thread calls it (the executors' resolver thread); the escalation side
+    channel (:func:`last_escalations`) and the escalation gate
+    (:func:`set_escalation_gate`) are THIS thread's."""
+    _tl.escalations = {}                 # fresh side channel for this call
+    routine, opts = pb.routine, pb.opts
+    a0, b, B = pb.a0, pb.b, pb.B
+    batch = a0.shape[0]
+    want_verdict = pb.want_verdict
+    payload, info = list(pb.out[:-1]), pb.out[-1]
 
     reports = None
     if opts.solve_report:
@@ -267,10 +308,18 @@ def _solve_batched(routine: str, A, B, opts, cache, donate):
             if len(r.fallback_chain) == 1:      # never escalated
                 r.recovered = r.info == 0 and i not in forced_bad
             r.finalize()
-    x = payload[0][..., 0] if squeeze else payload[0]
+    x = payload[0][..., 0] if pb.squeeze else payload[0]
     x = write_back(B, x) if x.shape == as_array(B).shape else x
     payload[0] = x
     return payload, info, reports
+
+
+def _solve_batched(routine: str, A, B, opts, cache, donate):
+    """Shared driver body; returns (payload tuple, info[, reports]).  The
+    one-shot composition of the dispatch/resolve halves the executor pool
+    runs on separate threads."""
+    return finish_batched(start_batched(routine, A, B, opts=opts,
+                                        cache=cache, donate=donate))
 
 
 @instrument

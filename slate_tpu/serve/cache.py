@@ -55,6 +55,16 @@ class ExecutableCache:
         self.capacity = int(capacity)
         self._lock = threading.Lock()
         self._table: "OrderedDict[tuple, Any]" = OrderedDict()
+        #: owning executor's label (set by the pool); when present, every
+        #: cache counter/histogram sample carries it as ``executor=`` so
+        #: per-executor hit rates are readable straight from metrics.json
+        self.owner: Optional[str] = None
+        # residency hooks (set by ExecutorPool): which executor holds which
+        # compiled key is the routing signal of the residency-aware
+        # scheduler; called OUTSIDE the cache lock
+        self.on_insert: Optional[Callable[[tuple], None]] = None
+        self.on_evict: Optional[Callable[[tuple], None]] = None
+        self.on_drop: Optional[Callable[[], None]] = None
         # plain-int mirror of the obs counters: tests and the smoke gate read
         # these without label arithmetic; the obs registry carries the same
         # events with routine/bucket labels for metrics.json
@@ -99,6 +109,8 @@ class ExecutableCache:
         t_lookup = time.perf_counter()
         key = self.make_key(routine, args, opts, donate)
         labels = self._labels(routine, args)
+        if self.owner is not None:
+            labels["executor"] = self.owner
         with self._lock:
             ex = self._table.get(key)
             if ex is not None:
@@ -125,12 +137,13 @@ class ExecutableCache:
         obs.histogram("slate_serve_compile_seconds",
                       "AOT compile time per cache miss").observe(
                           time.perf_counter() - t0, **labels)
+        evicted = []
         with self._lock:
             # a racing compile of the same key: last one wins, both usable
             self._table[key] = ex
             self._table.move_to_end(key)
             while len(self._table) > self.capacity:
-                self._table.popitem(last=False)
+                evicted.append(self._table.popitem(last=False)[0])
                 self.evictions += 1
                 _counter("slate_serve_cache_evictions_total",
                          "executable-cache LRU evictions").inc()
@@ -138,6 +151,12 @@ class ExecutableCache:
 
             _obs.gauge("slate_serve_cache_size",
                        "live executables in the cache").set(len(self._table))
+        # residency hooks fire outside the lock (the pool takes its own)
+        if self.on_insert is not None:
+            self.on_insert(key)
+        if self.on_evict is not None:
+            for k in evicted:
+                self.on_evict(k)
         self._calls.last = {"hit": False,
                             "seconds": time.perf_counter() - t_lookup,
                             "compile_seconds": time.perf_counter() - t0}
@@ -175,11 +194,22 @@ class ExecutableCache:
         forces stay visible as misses in the very stats that diagnose it."""
         with self._lock:
             self._table.clear()
+        if self.on_drop is not None:
+            self.on_drop()
 
     def clear(self) -> None:
         with self._lock:
             self._table.clear()
             self.hits = self.misses = self.evictions = 0
+        if self.on_drop is not None:
+            self.on_drop()
+
+    def holds(self, key: tuple) -> bool:
+        """Whether ``key`` (an exact :meth:`make_key` tuple) is resident —
+        a point-in-time read the routing layer uses without touching LRU
+        order or the hit/miss counters."""
+        with self._lock:
+            return key in self._table
 
     def __len__(self) -> int:
         with self._lock:

@@ -1,0 +1,1100 @@
+"""Executor pool: the multi-executor serving data path (ROADMAP item 2(b)).
+
+PR 7 finished serving's *control* half (admission, lanes, deadlines); the
+data path was still one worker thread doing flush-and-wait: every batch
+serialized pad -> compile/lookup -> execute -> resolve, and one backend
+capped throughput.  This module is the BLASX half of the design (PAPERS.md
+— a software cache plus a scheduler routing tasks by cache residency over
+heterogeneous executors, stealing across them when one backs up):
+
+* :class:`Executor` — one serving backend: its own
+  :class:`~slate_tpu.serve.cache.ExecutableCache`, a device binding, and
+  TWO threads splitting the batch lifecycle.  The **dispatch** thread pads/
+  packs a chunk, probes the cache, and enqueues the async device call
+  (:func:`~slate_tpu.serve.batched.start_batched` — JAX async dispatch
+  returns before the device finishes); the **resolver** thread syncs the
+  result, runs the verdict/escalation half, and completes tickets
+  (:func:`~slate_tpu.serve.batched.finish_batched`).  Host-side padding of
+  batch k+1 therefore overlaps device execution of batch k — the stage
+  histograms (pad vs execute, both ``executor``-labeled) make the overlap
+  directly measurable.
+* :class:`ExecutorPool` — N executors behind one
+  :class:`~slate_tpu.serve.queue.ServeQueue`.  Each popped bucket chunk is
+  routed by **cache residency first** (an executor already holding the
+  compiled executable for that (routine, bucket, batch, dtype, options)
+  key wins), falling back to least-loaded, and **work-stolen** to the
+  globally least-loaded executor when the resident home's depth passes
+  ``steal_threshold`` (``slate_serve_steals_total`` counts them).
+* **Drain-and-reroute death**: a dying executor fails only the batch it
+  was dispatching (typed ``worker thread died`` error, ``worker_death``
+  flight records, ``slate_serve_worker_deaths_total{executor=}``), its
+  already-dispatched batches drain through its resolver, its undispatched
+  chunks reroute to survivors (``slate_serve_requeued_chunks_total``), and
+  the pool fails-all only when the LAST executor dies — at which point the
+  queue's fail-fast contract (PR 7) takes over unchanged.
+
+The batch machinery itself (padding, ghost slots, stage decomposition,
+escalation gating, flight records) lives here too — :mod:`.queue` imports
+it for the synchronous :func:`~slate_tpu.serve.queue.solve_many` packer
+and re-exports the public names (``pad_request`` et al.) unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.exceptions import (NumericalError, SingularMatrixError,
+                               SlateError)
+from ..core.types import Options
+from ..robust.faults import inject_serve
+from ..utils import trace
+from . import batched as _batched
+from .admission import DEFAULT_LANE
+from .cache import ExecutableCache
+from .flight import FlightRecord, FlightRecorder
+
+#: queue-able routines -> batched driver.  This dict is ALSO the override
+#: hook (tests monkeypatch entries): the executors run the overlapped
+#: start/finish split only while an entry is the stock driver, and fall
+#: back to calling the (possibly patched) entry synchronously otherwise.
+DRIVERS = {
+    "gesv": _batched.gesv_batched,
+    "posv": _batched.posv_batched,
+    "gels": _batched.gels_batched,
+}
+
+#: pristine snapshot — identity comparison detects patched DRIVERS entries
+_STOCK_DRIVERS = dict(DRIVERS)
+
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+#: stage-latency histogram bounds — serving stages live in the us..s range,
+#: far below the registry default's multi-minute top end
+_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+#: the serving-fault injection site (robust.FaultSpec(driver=SERVE_SITE,
+#: kind="slow_executor" | "worker_crash" | "cache_flush"[, executor=k]))
+SERVE_SITE = "serve_batch"
+
+_TRACE_SEQ = itertools.count(1)
+
+
+def _new_trace_id(routine: str) -> str:
+    """Process-unique request trace id (stitches one request's spans,
+    ladder events, and flight record across the chrome-trace)."""
+    return f"{routine}-{os.getpid():x}-{next(_TRACE_SEQ):06d}"
+
+
+def _obs():
+    from .. import obs
+
+    return obs
+
+
+def pad_request(routine: str, a, b, bucket: Tuple[int, int, int]):
+    """Embed one request into its bucket shape, solution-preserving.
+
+    Square solves: ``A' = [[A, 0], [0, I]]``, ``b' = [b; 0]`` — the padded
+    block solves ``I z = 0`` (SPD-preserving for posv).  Least squares: the
+    same block embedding, with the identity carried on the padded rows x
+    padded cols corner so the padded normal equations are block-diagonal
+    (tall) / the padded minimum-norm system fixes z = 0 (wide)."""
+    bm, bn, br = bucket
+    m, n = a.shape[-2:]
+    nrhs = b.shape[-1]
+    pm, pn = bm - m, bn - n
+    # host-side numpy: the per-request pad must not cost an eager device
+    # dispatch per operand (the packer touches thousands of requests/sec)
+    ap = np.zeros((bm, bn), dtype=np.asarray(a).dtype)
+    ap[:m, :n] = np.asarray(a)
+    k = min(pm, pn)
+    if k:
+        # the identity block at (m, n); leftover padded rows (tall LS) or
+        # cols (wide LS) stay zero — the Gram/QR stays nonsingular because
+        # the identity covers the smaller padding side exactly
+        ap[m + np.arange(k), n + np.arange(k)] = 1
+    bp = np.zeros((bm, br), dtype=np.asarray(b).dtype)
+    bp[:m, :nrhs] = np.asarray(b)
+    return ap, bp
+
+
+def unpad_result(x, n: int, nrhs: int):
+    return x[..., :n, :nrhs]
+
+
+class Ticket:
+    """Async handle for one submitted request.
+
+    Beyond the result, a ticket carries the request's telemetry: a
+    process-unique ``trace_id`` (every span/event of this request in the
+    chrome-trace carries it), per-stage latencies in ``stages``
+    (submit / queue_wait / pad / cache / execute / resolve, seconds),
+    the executable-cache verdict (``cache_hit``), the serving executor
+    (``executor``), and the escalation-ladder rungs taken (``ladder`` /
+    ``exhausted``) — the same fields the flight recorder persists.  The
+    overload contract adds ``lane`` (priority lane) and ``deadline_s`` /
+    ``t_deadline`` (the submitted budget and its absolute ``perf_counter``
+    expiry; None = no deadline).
+    """
+
+    __slots__ = ("routine", "shape", "_event", "_value", "_error",
+                 "t_submit", "t_submit_unix", "latency_s", "trace_id",
+                 "stages", "cache_hit", "ladder", "exhausted",
+                 "lane", "deadline_s", "t_deadline", "executor")
+
+    def __init__(self, routine: str, shape, lane: str = DEFAULT_LANE,
+                 deadline: Optional[float] = None):
+        self.routine = routine
+        self.shape = shape
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_submit_unix = time.time()
+        self.latency_s: Optional[float] = None
+        self.trace_id = _new_trace_id(routine)
+        self.stages: Dict[str, float] = {}
+        self.cache_hit: Optional[bool] = None
+        self.ladder: Tuple[str, ...] = ()
+        self.exhausted = False
+        self.lane = lane
+        self.deadline_s = None if deadline is None else float(deadline)
+        self.t_deadline = (None if deadline is None
+                           else self.t_submit + float(deadline))
+        self.executor = ""
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until solved; returns ``(x, info)`` (x unpadded)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"{self.routine} request not served within "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _resolve(self, value=None, error: Optional[BaseException] = None):
+        if self._event.is_set():
+            return                       # first resolution wins (death races)
+        self.latency_s = time.perf_counter() - self.t_submit
+        self._value, self._error = value, error
+        self._event.set()
+
+
+class _Pending:
+    __slots__ = ("ticket", "a", "b", "n", "nrhs")
+
+    def __init__(self, ticket, a, b, n, nrhs):
+        self.ticket, self.a, self.b = ticket, a, b
+        self.n, self.nrhs = n, nrhs
+
+
+class Chunk:
+    """One popped (lane, routine, bucket, dtype) batch of pending requests
+    — the routing unit between the queue's scheduler and the pool."""
+
+    __slots__ = ("key", "items")
+
+    def __init__(self, key: tuple, items: Sequence[_Pending]):
+        self.key = key
+        self.items = list(items)
+
+    @property
+    def lane(self) -> str:
+        return self.key[0]
+
+    @property
+    def routine(self) -> str:
+        return self.key[1]
+
+    @property
+    def bucket(self) -> Tuple[int, int, int]:
+        return self.key[2]
+
+    @property
+    def dtype(self) -> str:
+        return self.key[3]
+
+
+def executable_key(policy, opts: Options, routine: str,
+                   bucket: Tuple[int, int, int], dtype, n_items: int
+                   ) -> tuple:
+    """The exact :meth:`ExecutableCache.make_key` a chunk will compile/hit
+    — the residency-routing signal.  Computed host-side from the bucket
+    and the rounded batch, no arrays touched."""
+    nb = policy.round_batch(n_items)
+    bm, bn, br = bucket
+    dt = np.dtype(dtype)
+    args = [jax.ShapeDtypeStruct((nb, bm, bn), dt),
+            jax.ShapeDtypeStruct((nb, bm, br), dt)]
+    return ExecutableCache.make_key(routine + "_batched", args, opts, False)
+
+
+def _stage_hist(obs, name: str, help: str):
+    return obs.histogram(name, help, buckets=_STAGE_BUCKETS)
+
+
+def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
+                   n_real: int, error: Optional[str] = None,
+                   reason: Optional[str] = None,
+                   executor: str = "") -> FlightRecord:
+    tk = it.ticket
+    info = None
+    if error is None and tk._value is not None:
+        info = int(tk._value[1])
+    return FlightRecord(
+        trace_id=tk.trace_id, routine=routine, bucket=bucket_s,
+        dtype=str(it.a.dtype), t_submit_unix=tk.t_submit_unix,
+        stages=dict(tk.stages), info=info, cache_hit=tk.cache_hit,
+        batch=nb, occupancy=n_real / max(nb, 1), ladder=tk.ladder,
+        exhausted=tk.exhausted, error=error, lane=tk.lane, reason=reason,
+        deadline_s=tk.deadline_s, executor=executor or tk.executor)
+
+
+def _capped_error(routine: str, info: int) -> NumericalError:
+    """The typed error a capped-escalation element resolves with: its own
+    numerical failure class, annotated with why no ladder ran (``info==0``
+    means the verdict tripped on a non-finite payload, not a pivot)."""
+    what = f"info={info}" if info else "non-finite result"
+    msg = (f"serve: {routine} element failed ({what}) and the per-window "
+           "escalation budget was exhausted — no ladder re-run")
+    if info > 0:
+        return SingularMatrixError(msg, info=info)
+    return NumericalError(msg)
+
+
+def _pack_batch(routine: str, bucket: Tuple[int, int, int],
+                items: Sequence[_Pending], nb: int,
+                device=None) -> Tuple[Any, Any]:
+    """Pad + pack one chunk into its (nb, bm, *) operands — ghost slots
+    are well-posed identity systems (I x = 0; SPD, full-rank — valid for
+    all three routines), NOT copies of the last request: a failing real
+    element must not multiply its own failure across the pad and burn
+    escalation budget / ladder re-runs on ghosts.  One host->device
+    transfer per packed operand, not one per request."""
+    padded = [pad_request(routine, it.a, it.b, bucket) for it in items]
+    if len(padded) < nb:
+        ghost = (np.eye(bucket[0], bucket[1], dtype=padded[0][0].dtype),
+                 np.zeros((bucket[0], bucket[2]),
+                          dtype=padded[0][1].dtype))
+        padded += [ghost] * (nb - len(padded))
+    A = np.stack([p[0] for p in padded])
+    B = np.stack([p[1] for p in padded])
+    if device is not None:
+        return jax.device_put(A, device), jax.device_put(B, device)
+    return jnp.asarray(A), jnp.asarray(B)
+
+
+def _deliver_batch(items: Sequence[_Pending], routine: str, bucket_s: str,
+                   nb: int, xs: np.ndarray, infos: np.ndarray,
+                   escal: Dict[int, Dict[str, Any]],
+                   cache_info: Optional[Dict[str, Any]],
+                   stage_times: Dict[str, float],
+                   flight: Optional[FlightRecorder],
+                   executor: str = "") -> None:
+    """Unpad + resolve every ticket of one executed batch and leave the
+    per-request evidence (stage maps, latency histogram, retrospective
+    trace spans, flight records).  Shared by the single-thread packer and
+    the executors' resolver threads."""
+    obs = _obs()
+    cache_s = (cache_info or {}).get("seconds", 0.0)
+    t_pad0, t_pad1 = stage_times["pad0"], stage_times["pad1"]
+    t_exec1, exec_s = stage_times["exec1"], stage_times["exec_s"]
+    t0 = stage_times["t0"]
+    res_spans: List[Tuple[float, float]] = []
+    t_res = time.perf_counter()           # stage: unpad + resolve
+    for i, it in enumerate(items):
+        tk = it.ticket
+        tk.stages["pad"] = t_pad1 - t_pad0
+        tk.stages["cache"] = cache_s
+        tk.stages["execute"] = exec_s
+        tk.cache_hit = (cache_info or {}).get("hit")
+        tk.executor = executor
+        capped = False
+        e = escal.get(i)
+        if e is not None:
+            tk.ladder = tuple(e["rungs"])
+            tk.exhausted = not e["recovered"]
+            capped = bool(e.get("capped"))
+        if int(infos[i]) != 0:
+            tk.exhausted = True
+        # per-request interval: this request's OWN unpad, stamped before
+        # delivery so the waiter sees a complete stage map (only the
+        # Event.set itself falls outside the measured interval)
+        value = (unpad_result(xs[i], it.n, it.nrhs), int(infos[i]))
+        now = time.perf_counter()
+        tk.stages["resolve"] = now - t_res
+        res_spans.append((t_res, now))
+        t_res = now
+        # a capped element is bad by info OR by finiteness (the same
+        # verdict that queued it for escalation — an overflowed payload
+        # can carry info==0)
+        if capped and (int(infos[i]) != 0
+                       or not np.all(np.isfinite(xs[i]))):
+            # the graceful-degradation contract: a failed element whose
+            # ladder re-run the budget refused resolves with its typed
+            # error (recovered=False), not a silent bad payload
+            tk.exhausted = True
+            tk._resolve(error=_capped_error(routine, int(infos[i])))
+        else:
+            tk._resolve(value)
+    exhausted_rec = None
+    for i, it in enumerate(items):
+        tk = it.ticket
+        # the lane label is what lane-level latency SLOs (the overload
+        # soak's interactive-p99 objective) filter on; per-routine SLOs
+        # still subset-match on routine alone
+        _stage_hist(obs, "slate_serve_latency_seconds",
+                    "submit-to-result latency per request").observe(
+                        tk.latency_s, routine=routine, lane=tk.lane)
+        if trace.is_on():
+            # retrospective per-request stage spans: one request's lifeline,
+            # stitchable from the interleaved timeline by args.trace_id
+            common = {"trace_id": tk.trace_id, "routine": routine,
+                      "bucket": bucket_s}
+            if executor:
+                common["executor"] = executor
+            trace.emit_span("serve.queue_wait", tk.t_submit, t0, **common)
+            trace.emit_span("serve.pad", t_pad0, t_pad1, **common)
+            trace.emit_span("serve.cache", t_pad1, t_pad1 + cache_s,
+                            hit=tk.cache_hit, **common)
+            trace.emit_span("serve.execute", t_pad1 + cache_s, t_exec1,
+                            **common)
+            trace.emit_span("serve.resolve", *res_spans[i], **common)
+        if flight is not None:
+            err_s = (f"{type(tk._error).__name__}: {tk._error}"
+                     if tk._error is not None else None)
+            rec = _flight_record(it, routine, bucket_s, nb, len(items),
+                                 error=err_s, executor=executor)
+            flight.record(rec)
+            if tk.exhausted:
+                exhausted_rec = rec
+    if flight is not None and exhausted_rec is not None:
+        # one dump per batch, after every record is in the ring — a batch of
+        # 32 failing elements must not rewrite the ring file 32 times on the
+        # serving worker thread (the worker-error path dedupes the same way)
+        flight.on_exhaustion(exhausted_rec)
+
+
+def _fail_batch(items: Sequence[_Pending], routine: str, bucket_s: str,
+                nb: int, exc: BaseException,
+                flight: Optional[FlightRecorder],
+                reason: str = "worker_error",
+                resolve_error: Optional[BaseException] = None,
+                executor: str = "") -> None:
+    """One batch died on a worker exception: surface it on every ticket,
+    in the registry, the timeline, and the flight recorder — not only
+    through whichever ticket happens to be awaited first."""
+    obs = _obs()
+    labels = {"routine": routine, "bucket": bucket_s}
+    if reason == "worker_error":
+        obs.counter("slate_serve_worker_errors_total",
+                    "worker-thread exceptions while serving a batch").inc(
+                        error=type(exc).__name__, **labels)
+        trace.trace_event("worker_error", error=type(exc).__name__, **labels)
+    err = resolve_error if resolve_error is not None else exc
+    last_rec = None
+    for it in items:
+        if not it.ticket.done():
+            it.ticket._resolve(error=err)
+        if flight is not None:
+            last_rec = _flight_record(it, routine, bucket_s, nb,
+                                      len(items),
+                                      error=f"{type(exc).__name__}: {exc}",
+                                      reason=reason, executor=executor)
+            flight.record(last_rec)
+    if flight is not None and last_rec is not None:
+        flight.on_exhaustion(last_rec, reason=reason)
+
+
+def _batch_counters(obs, labels: Dict[str, str], n_items: int, nb: int,
+                    t0: float) -> None:
+    obs.counter("slate_serve_batches_total",
+                "executed batches").inc(**labels)
+    obs.histogram("slate_serve_batch_occupancy",
+                  "real requests / padded batch slots",
+                  buckets=_OCCUPANCY_BUCKETS).observe(
+                      n_items / max(nb, 1), **labels)
+    obs.histogram("slate_serve_batch_seconds",
+                  "wall time per executed batch").observe(
+                      time.perf_counter() - t0, **labels)
+
+
+def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
+                      items: Sequence[_Pending], opts: Options,
+                      cache: ExecutableCache, policy,
+                      flight: Optional[FlightRecorder] = None,
+                      esc_gate: Optional[Callable[[int], int]] = None
+                      ) -> None:
+    """Pad + pack one bucket's requests, run the batched driver, distribute
+    — the single-thread composition the synchronous :func:`solve_many`
+    packer runs (the executors split the same stages across their
+    dispatch/resolve threads instead).
+
+    Stage decomposition (per request, into ``ticket.stages`` + the
+    ``slate_serve_*_seconds`` histograms + synthesized chrome-trace spans):
+    queue_wait (submit -> batch start, per request), pad (host-side pack),
+    cache (executable lookup + possible compile, from the cache's per-call
+    probe), execute (dispatch + compute + verdict sync, the driver call with
+    the cache share subtracted), resolve (unpad + ticket delivery).
+
+    ``esc_gate`` (the queue's escalation budget) caps how many failed
+    elements may ladder-re-run; capped elements resolve with their typed
+    numerical error.  Serving chaos (an active
+    :class:`~slate_tpu.robust.FaultPlan` with ``serve``-point specs at
+    :data:`SERVE_SITE`) fires here, before the batch executes:
+    ``slow_executor`` stalls, ``cache_flush`` wipes the executable cache,
+    ``worker_crash`` raises — which in the pool kills that executor and
+    exercises drain-and-reroute (fail-fast when it was the last one).
+    """
+    obs = _obs()
+    bucket_s = "x".join(str(d) for d in bucket)
+    labels = {"routine": routine, "bucket": bucket_s}
+    for spec in inject_serve(SERVE_SITE):
+        if spec.kind == "slow_executor":
+            time.sleep(spec.delay_s)
+        elif spec.kind == "cache_flush":
+            cache.drop()
+            obs.counter("slate_serve_cache_flushes_total",
+                        "chaos-injected executable-cache wipes").inc(**labels)
+        elif spec.kind == "worker_crash":
+            # deliberately NOT a SlateError: simulates an unexpected crash
+            # (the class the worker-death handler must survive)
+            raise RuntimeError("chaos: injected worker crash")
+    t0 = time.perf_counter()
+    nb = policy.round_batch(len(items))
+    for it in items:                      # stage: queue wait (per request)
+        wait = t0 - it.ticket.t_submit
+        it.ticket.stages["queue_wait"] = wait
+        _stage_hist(obs, "slate_serve_queue_wait_seconds",
+                    "submit-to-batch-start wait per request").observe(
+                        wait, routine=routine)
+    prev_gate = _batched.set_escalation_gate(esc_gate)
+    try:
+        t_pad0 = time.perf_counter()      # stage: pad + pack
+        A, B = _pack_batch(routine, bucket, items, nb)
+        t_pad1 = time.perf_counter()
+        _stage_hist(obs, "slate_serve_pad_seconds",
+                    "host-side pad+pack time per batch").observe(
+                        t_pad1 - t_pad0, **labels)
+        # stage: cache + execute.  The batch-level span blocks on the device
+        # result before closing (device_sync) so async dispatch cannot
+        # masquerade as compute time; the per-element escalation below the
+        # driver sees the owning request ids via the batch scope.
+        with trace.batch_request_scope([it.ticket.trace_id for it in items]):
+            # ("routine" is scope()'s span-name slot; the serving routine
+            # rides as the "driver" label instead)
+            with obs.scope("serve.execute_batch", device_sync=True,
+                           driver=routine, bucket=bucket_s) as sp:
+                out = DRIVERS[routine](A, B, opts, cache=cache)
+                x, info = out[0], out[-1]
+                sp.set_result(x)
+            escal = _batched.last_escalations()
+        t_exec1 = time.perf_counter()
+        cache_info = cache.last_lookup()
+        cache_s = (cache_info or {}).get("seconds", 0.0)
+        exec_s = max(t_exec1 - t_pad1 - cache_s, 0.0)
+        _stage_hist(obs, "slate_serve_execute_seconds",
+                    "device execute time per batch (cache share "
+                    "subtracted, result blocked on)").observe(
+                        exec_s, **labels)
+        xs = np.asarray(x)
+        infos = np.asarray(info)
+    # slate-lint: disable=SLT501 -- not a swallow: the exception (taxonomy
+    # included) is re-surfaced on every pending ticket, whose result() call
+    # re-raises it in the submitter's thread; raising here would instead
+    # kill the queue worker and strand the other buckets
+    except BaseException as e:  # noqa: BLE001 - surfaced on every ticket
+        _fail_batch(items, routine, bucket_s, nb, e, flight)
+        return
+    finally:
+        _batched.set_escalation_gate(prev_gate)
+        _batch_counters(obs, labels, len(items), nb, t0)
+    _deliver_batch(items, routine, bucket_s, nb, xs, infos, escal,
+                   cache_info,
+                   {"t0": t0, "pad0": t_pad0, "pad1": t_pad1,
+                    "exec1": t_exec1, "exec_s": exec_s}, flight)
+
+
+class _InFlight:
+    """One dispatched-but-unresolved batch riding between an executor's
+    dispatch and resolver threads."""
+
+    __slots__ = ("chunk", "nb", "bucket_s", "labels", "t0", "t_pad0",
+                 "t_pad1", "t_exec1", "pending", "sync_out", "sync_escal",
+                 "cache_info", "error")
+
+    def __init__(self, chunk: Chunk, nb: int, bucket_s: str,
+                 labels: Dict[str, str], t0: float):
+        self.chunk, self.nb = chunk, nb
+        self.bucket_s, self.labels, self.t0 = bucket_s, labels, t0
+        self.t_pad0 = self.t_pad1 = self.t_exec1 = t0
+        self.pending: Optional[_batched.PendingBatch] = None
+        self.sync_out: Optional[Tuple[Any, Any]] = None
+        self.sync_escal: Optional[Dict[int, Dict[str, Any]]] = None
+        self.cache_info: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
+
+
+class Executor:
+    """One serving backend of the pool: its own executable cache, device
+    binding, and the dispatch/resolve thread pair (see module docstring).
+
+    ``depth()`` — queued + in-flight chunks — is the pool's load signal
+    for least-loaded routing and work-stealing, published live as
+    ``slate_serve_executor_depth{executor=}``.
+    """
+
+    def __init__(self, index: int, pool: "ExecutorPool",
+                 cache: ExecutableCache, policy, opts: Options,
+                 flight: Optional[FlightRecorder],
+                 esc_gate: Optional[Callable[[int], int]] = None,
+                 inflight_limit: int = 2):
+        self.index = int(index)
+        self.name = f"ex{index}"
+        self.pool = pool
+        self.cache = cache
+        self.policy = policy
+        self.opts = opts
+        self.flight = flight
+        self.esc_gate = esc_gate
+        #: dispatched-but-unresolved bound: how far ahead of the resolver
+        #: the dispatcher may run (the pad/execute overlap window)
+        self.inflight_limit = max(int(inflight_limit), 1)
+        devices = jax.devices()
+        #: nominal device binding (round-robin over visible devices) —
+        #: advisory on CPU, where every executor shares the host backend
+        #: and placement follows the AOT-compiled program; on a real
+        #: multi-device mesh, per-executor caches would compile against it
+        self.device = devices[self.index % len(devices)]
+        self.dead: Optional[BaseException] = None
+        self.closed = False
+        self._cv = threading.Condition()
+        self._work: "deque[Chunk]" = deque()
+        self._resolve_q: "deque[_InFlight]" = deque()
+        self._depth = 0                  # queued + in-flight chunks
+        self._current: Optional[Chunk] = None
+        self._dispatch_done = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"slate-serve-{self.name}-dispatch")
+        self._resolver = threading.Thread(
+            target=self._resolve_loop, daemon=True,
+            name=f"slate-serve-{self.name}-resolve")
+        self._started = False
+
+    # -- pool-facing surface -------------------------------------------------
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._dispatcher.start()
+            self._resolver.start()
+
+    def alive(self) -> bool:
+        return self.dead is None and not self.closed
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    def enqueue(self, chunk: Chunk) -> None:
+        with self._cv:
+            if self.dead is not None or self.closed:
+                raise SlateError(f"serve: executor {self.name} is not "
+                                 "accepting work")
+            self._work.append(chunk)
+            self._depth += 1
+            self._cv.notify_all()
+        self._publish_depth()
+
+    def close(self) -> None:
+        """Stop accepting work; the dispatcher drains ``_work`` and the
+        resolver drains the in-flight queue before the threads exit."""
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._started:
+            deadline = None if timeout is None else \
+                time.monotonic() + timeout
+            self._dispatcher.join(timeout)
+            left = None if deadline is None else \
+                max(deadline - time.monotonic(), 0.0)
+            self._resolver.join(left)
+
+    def _publish_depth(self) -> None:
+        _obs().gauge("slate_serve_executor_depth",
+                     "queued + in-flight chunks per executor").set(
+                         self.depth(), executor=self.name)
+
+    # -- dispatch thread -----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while self.dead is None and (
+                            (not self._work and not self.closed)
+                            or (self._work and len(self._resolve_q)
+                                >= self.inflight_limit)):
+                        self._cv.wait()
+                    if self.dead is not None:
+                        return
+                    if not self._work:
+                        return           # closed and drained
+                    chunk = self._work.popleft()
+                    self._current = chunk
+                inf = self._dispatch(chunk)
+                with self._cv:
+                    self._current = None
+                    if inf is not None:
+                        self._resolve_q.append(inf)
+                    else:
+                        # every item expired at dispatch time: nothing to
+                        # resolve, close out the chunk here
+                        self._depth -= 1
+                    self._cv.notify_all()
+                if inf is None:
+                    self._publish_depth()
+                    self.pool.chunk_done(self, chunk)
+        # slate-lint: disable=SLT501 -- not a swallow: the death boundary;
+        # _die fails the in-flight batch's tickets with the typed error and
+        # reroutes pending chunks, and no solve runs after the handler
+        except BaseException as e:  # noqa: BLE001 - drain-and-reroute
+            self._die(e)
+        finally:
+            with self._cv:
+                self._dispatch_done = True
+                self._cv.notify_all()
+
+    def _sweep_deadlines(self, chunk: Chunk) -> bool:
+        """Expire chunk items whose deadline has passed (same typed expiry
+        as the queue's in-_pending sweep).  Returns False when the chunk
+        emptied — nothing left worth a batch slot."""
+        now = time.perf_counter()
+        expired = [it for it in chunk.items
+                   if it.ticket.t_deadline is not None
+                   and now >= it.ticket.t_deadline
+                   and not it.ticket.done()]
+        if expired:
+            chunk.items = [it for it in chunk.items if it not in expired]
+            for it in expired:
+                self.pool.item_expired(chunk.key, it)
+        return bool(chunk.items)
+
+    def _dispatch(self, chunk: Chunk) -> Optional[_InFlight]:
+        """Host half of one batch: deadline sweep, chaos hook, pad/pack,
+        cache probe, and the ASYNC device call — no sync; the resolver
+        owns completion.  Returns None when every item expired."""
+        obs = _obs()
+        routine, bucket = chunk.routine, chunk.bucket
+        # dispatch-time deadline sweep: a chunk can sit behind others in
+        # this executor's queue past some items' deadlines — they get the
+        # same typed expiry as the queue's in-_pending sweep, and never
+        # waste a batch slot
+        if not self._sweep_deadlines(chunk):
+            return None
+        bucket_s = "x".join(str(d) for d in bucket)
+        labels = {"routine": routine, "bucket": bucket_s}
+        # the chaos hook fires OUTSIDE the try: worker_crash is an executor
+        # death (drain-and-reroute), not a per-batch worker_error
+        for spec in inject_serve(SERVE_SITE, executor=self.index):
+            if spec.kind == "slow_executor":
+                time.sleep(spec.delay_s)
+            elif spec.kind == "cache_flush":
+                self.cache.drop()
+                obs.counter("slate_serve_cache_flushes_total",
+                            "chaos-injected executable-cache wipes").inc(
+                                **labels)
+            elif spec.kind == "worker_crash":
+                raise RuntimeError("chaos: injected worker crash")
+        # re-sweep: a chaos stall (slow_executor) may have carried us past
+        # deadlines that were live at pop time — expire, don't serve late
+        if not self._sweep_deadlines(chunk):
+            return None
+        items = chunk.items
+        t0 = time.perf_counter()
+        nb = self.policy.round_batch(len(items))
+        for it in items:                  # stage: queue wait (per request)
+            wait = t0 - it.ticket.t_submit
+            it.ticket.stages["queue_wait"] = wait
+            _stage_hist(obs, "slate_serve_queue_wait_seconds",
+                        "submit-to-batch-start wait per request").observe(
+                            wait, routine=routine)
+        inf = _InFlight(chunk, nb, bucket_s, labels, t0)
+        try:
+            inf.t_pad0 = time.perf_counter()
+            # no explicit device_put: operand placement must follow the
+            # AOT-compiled program's own placement (the cache compiles
+            # without a device pin; a committed mismatched operand is a
+            # hard error, not a transfer)
+            A, B = _pack_batch(routine, bucket, items, nb)
+            inf.t_pad1 = time.perf_counter()
+            _stage_hist(obs, "slate_serve_pad_seconds",
+                        "host-side pad+pack time per batch").observe(
+                            inf.t_pad1 - inf.t_pad0, executor=self.name,
+                            **labels)
+            drv = DRIVERS.get(routine)
+            if drv is not None and drv is _STOCK_DRIVERS.get(routine):
+                # the overlapped path: enqueue the async device call and
+                # hand the pending batch to the resolver thread
+                inf.pending = _batched.start_batched(
+                    routine + "_batched", A, B, opts=self.opts,
+                    cache=self.cache)
+            else:
+                # patched/custom driver (DRIVERS is the override hook):
+                # run it synchronously here — no split available for an
+                # arbitrary callable
+                prev_gate = _batched.set_escalation_gate(self.esc_gate)
+                try:
+                    with trace.batch_request_scope(
+                            [it.ticket.trace_id for it in items]):
+                        out = drv(A, B, self.opts, cache=self.cache)
+                        inf.sync_escal = _batched.last_escalations()
+                finally:
+                    _batched.set_escalation_gate(prev_gate)
+                inf.sync_out = (out[0], out[-1])
+                inf.t_exec1 = time.perf_counter()
+            # the cache probe is thread-local: read it HERE, on the thread
+            # that did the lookup, before handing off to the resolver
+            inf.cache_info = self.cache.last_lookup()
+        # slate-lint: disable=SLT501 -- not a swallow: the error rides the
+        # in-flight record to the resolver, which re-surfaces it on every
+        # ticket of this batch (worker_error path); the executor survives
+        except BaseException as e:  # noqa: BLE001 - surfaced per ticket
+            inf.error = e
+        return inf
+
+    # -- resolver thread -----------------------------------------------------
+    def _resolve_loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while (not self._resolve_q and not self._dispatch_done
+                           and self.dead is None):
+                        self._cv.wait()
+                    if not self._resolve_q:
+                        # dead or closed+drained; either way nothing more
+                        # will be dispatched (already-dispatched batches
+                        # above were drained first)
+                        return
+                    inf = self._resolve_q.popleft()
+                    self._cv.notify_all()     # free the dispatcher's slot
+                self._resolve(inf)
+                with self._cv:
+                    self._depth -= 1
+                    self._cv.notify_all()
+                self._publish_depth()
+                self.pool.chunk_done(self, inf.chunk)
+        # slate-lint: disable=SLT501 -- not a swallow: the death boundary;
+        # _die re-surfaces the exception on the stranded tickets
+        except BaseException as e:  # noqa: BLE001 - drain-and-reroute
+            self._die(e)
+
+    def _resolve(self, inf: _InFlight) -> None:
+        """Device half of one batch: sync the result, verdict/escalate,
+        deliver tickets.  Never raises — a failure is the worker_error
+        path (this batch's tickets fail, the executor survives)."""
+        obs = _obs()
+        chunk, items, nb = inf.chunk, inf.chunk.items, inf.nb
+        routine, bucket_s = chunk.routine, inf.bucket_s
+        try:
+            if inf.error is not None:
+                raise inf.error
+            if inf.sync_out is not None:
+                x, info = inf.sync_out
+                escal = inf.sync_escal or {}
+                t_exec1 = inf.t_exec1
+            else:
+                prev_gate = _batched.set_escalation_gate(self.esc_gate)
+                try:
+                    with trace.batch_request_scope(
+                            [it.ticket.trace_id for it in items]):
+                        payload, info, _reports = _batched.finish_batched(
+                            inf.pending)
+                        x = payload[0]
+                        escal = _batched.last_escalations()
+                finally:
+                    _batched.set_escalation_gate(prev_gate)
+                t_exec1 = time.perf_counter()
+                inf.t_exec1 = t_exec1
+            cache_s = (inf.cache_info or {}).get("seconds", 0.0)
+            exec_s = max(t_exec1 - inf.t_pad1 - cache_s, 0.0)
+            _stage_hist(obs, "slate_serve_execute_seconds",
+                        "device execute time per batch (cache share "
+                        "subtracted, result blocked on)").observe(
+                            exec_s, executor=self.name, **inf.labels)
+            if trace.is_on():
+                trace.emit_span("serve.execute_batch", inf.t_pad1, t_exec1,
+                                driver=routine, bucket=bucket_s,
+                                executor=self.name)
+            xs = np.asarray(x)
+            infos = np.asarray(info)
+        # slate-lint: disable=SLT501 -- not a swallow: re-surfaced on every
+        # ticket of this batch (worker_error), executor keeps serving
+        except BaseException as e:  # noqa: BLE001 - surfaced per ticket
+            _fail_batch(items, routine, bucket_s, nb, e, self.flight,
+                        executor=self.name)
+            return
+        finally:
+            _batch_counters(obs, inf.labels, len(items), nb, inf.t0)
+        _deliver_batch(items, routine, bucket_s, nb, xs, infos, escal,
+                       inf.cache_info,
+                       {"t0": inf.t0, "pad0": inf.t_pad0,
+                        "pad1": inf.t_pad1, "exec1": t_exec1,
+                        "exec_s": exec_s},
+                       self.flight, executor=self.name)
+
+    # -- death ---------------------------------------------------------------
+    def _die(self, exc: BaseException) -> None:
+        """Drain-and-reroute: fail ONLY the batch this executor was
+        actively working (typed error), hand undispatched chunks back to
+        the pool for surviving executors, and let already-dispatched
+        batches drain through whichever of the two threads is still
+        alive."""
+        with self._cv:
+            if self.dead is not None:
+                return                    # one death per executor
+            self.dead = exc
+            pending = list(self._work)
+            self._work.clear()
+            failed = self._current
+            self._current = None
+            self._depth = len(self._resolve_q)
+            self._cv.notify_all()
+        self._publish_depth()
+        obs = _obs()
+        obs.counter("slate_serve_worker_deaths_total",
+                    "serving worker threads lost to exceptions").inc(
+                        error=type(exc).__name__, executor=self.name)
+        trace.trace_event("worker_death", error=type(exc).__name__,
+                          executor=self.name)
+        self.pool.on_executor_died(self, exc, pending, failed)
+
+
+class ExecutorPool:
+    """N executors behind one serving queue: residency-aware routing,
+    least-loaded fallback, work-stealing, drain-and-reroute death (see
+    module docstring).
+
+    The pool owns the residency index — every executor cache reports
+    inserts/evictions/wipes through the :class:`ExecutableCache` hooks —
+    and three callbacks wire it to the queue: ``on_chunk_done(chunk)``
+    (accounting), ``on_executor_death(alive, total, exc)`` (capacity
+    recalibration), ``on_all_dead(exc, stranded_items)`` (the fail-fast
+    endgame).
+    """
+
+    def __init__(self, n: int, policy, opts: Options,
+                 caches: Sequence[ExecutableCache],
+                 flight: Optional[FlightRecorder] = None,
+                 esc_gate: Optional[Callable[[int], int]] = None,
+                 steal_threshold: int = 4,
+                 inflight_limit: int = 2,
+                 on_chunk_done: Optional[Callable[[Chunk], None]] = None,
+                 on_item_expired: Optional[
+                     Callable[[tuple, _Pending], None]] = None,
+                 on_executor_death: Optional[
+                     Callable[[int, int, BaseException], None]] = None,
+                 on_all_dead: Optional[
+                     Callable[[BaseException, List[_Pending]], None]] = None):
+        if n < 1:
+            raise SlateError(f"serve: executor pool needs >= 1 executor, "
+                             f"got {n}")
+        if len(caches) != n:
+            raise SlateError(f"serve: {n} executors need {n} caches, "
+                             f"got {len(caches)}")
+        self.policy = policy
+        self.opts = opts
+        self.steal_threshold = max(int(steal_threshold), 1)
+        #: per-executor work acceptance bound: deep enough for imbalance to
+        #: trigger steals, shallow enough that lane priority is re-decided
+        #: at the queue, not buried in executor deques
+        self.queue_bound = self.steal_threshold + 2
+        self._on_chunk_done = on_chunk_done
+        self._on_item_expired = on_item_expired
+        self._on_executor_death = on_executor_death
+        self._on_all_dead = on_all_dead
+        self._lock = threading.Lock()
+        #: executable key -> executor indices holding the compiled program
+        self._residency: Dict[tuple, set] = {}
+        self.executors: List[Executor] = []
+        for i in range(n):
+            self._wire_cache(caches[i], i)
+            self.executors.append(Executor(
+                i, self, caches[i], policy, opts, flight,
+                esc_gate=esc_gate, inflight_limit=inflight_limit))
+        self.steals = 0
+
+    # -- residency index -----------------------------------------------------
+    def _wire_cache(self, cache: ExecutableCache, index: int) -> None:
+        cache.owner = f"ex{index}"
+        cache.on_insert = lambda key, i=index: self._note_insert(key, i)
+        cache.on_evict = lambda key, i=index: self._note_evict(key, i)
+        cache.on_drop = lambda i=index: self._note_drop(i)
+
+    def _note_insert(self, key: tuple, index: int) -> None:
+        with self._lock:
+            self._residency.setdefault(key, set()).add(index)
+
+    def _note_evict(self, key: tuple, index: int) -> None:
+        with self._lock:
+            holders = self._residency.get(key)
+            if holders is not None:
+                holders.discard(index)
+                if not holders:
+                    del self._residency[key]
+
+    def _note_drop(self, index: int) -> None:
+        with self._lock:
+            for key in [k for k, holders in self._residency.items()
+                        if index in holders]:
+                self._residency[key].discard(index)
+                if not self._residency[key]:
+                    del self._residency[key]
+
+    def residency(self, key: tuple) -> Tuple[int, ...]:
+        """Executor indices currently holding ``key`` (diagnostics + the
+        routing tests)."""
+        with self._lock:
+            return tuple(sorted(self._residency.get(key, ())))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        for ex in self.executors:
+            ex.start()
+
+    def caches(self) -> List[ExecutableCache]:
+        return [ex.cache for ex in self.executors]
+
+    def alive(self) -> List[Executor]:
+        return [ex for ex in self.executors if ex.dead is None]
+
+    def alive_count(self) -> int:
+        return len(self.alive())
+
+    def size(self) -> int:
+        return len(self.executors)
+
+    def can_accept(self) -> bool:
+        """Whether some live executor has room — the scheduler's gate for
+        popping the next chunk (keeps executor deques shallow so lane
+        priority stays a queue-level decision)."""
+        return any(ex.depth() < self.queue_bound for ex in self.executors
+                   if ex.dead is None and not ex.closed)
+
+    def close(self, timeout: float = 30.0) -> None:
+        for ex in self.executors:
+            ex.close()
+        deadline = time.monotonic() + timeout
+        for ex in self.executors:
+            ex.join(max(deadline - time.monotonic(), 0.0))
+
+    # -- routing -------------------------------------------------------------
+    def dispatch(self, chunk: Chunk) -> Executor:
+        """Route one chunk: residency first, least-loaded fallback,
+        steal past the threshold.  Raises :class:`SlateError` when no
+        executor is live."""
+        ex = self._route(chunk)
+        if ex is None:
+            raise SlateError("serve: no live executors")
+        ex.enqueue(chunk)
+        return ex
+
+    def _route(self, chunk: Chunk) -> Optional[Executor]:
+        alive = [ex for ex in self.executors
+                 if ex.dead is None and not ex.closed]
+        if not alive:
+            return None
+        if len(alive) == 1:
+            return alive[0]
+        by_load = min(alive, key=lambda ex: (ex.depth(), ex.index))
+        key = executable_key(self.policy, self.opts, chunk.routine,
+                             chunk.bucket, chunk.dtype, len(chunk.items))
+        with self._lock:
+            holders = set(self._residency.get(key, ()))
+        resident = [ex for ex in alive if ex.index in holders]
+        if not resident:
+            return by_load               # cold key: least-loaded compiles it
+        home = min(resident, key=lambda ex: (ex.depth(), ex.index))
+        home_depth = home.depth()
+        if home_depth >= self.steal_threshold and by_load is not home \
+                and by_load.depth() < home_depth:
+            # the residency win is not worth the line: steal to the
+            # least-loaded executor (it compiles/receives the program)
+            self.steals += 1
+            _obs().counter("slate_serve_steals_total",
+                           "chunks stolen from a backed-up resident "
+                           "executor").inc(routine=chunk.routine,
+                                           src=home.name, dst=by_load.name)
+            trace.trace_event("work_steal", routine=chunk.routine,
+                              src=home.name, dst=by_load.name)
+            return by_load
+        return home
+
+    # -- executor callbacks --------------------------------------------------
+    def chunk_done(self, ex: Executor, chunk: Chunk) -> None:
+        if self._on_chunk_done is not None:
+            self._on_chunk_done(chunk)
+
+    def item_expired(self, key: tuple, it: _Pending) -> None:
+        """An executor swept one past-deadline item out of a routed chunk
+        at dispatch time — forward to the queue's expiry path (typed
+        error + evidence trail)."""
+        if self._on_item_expired is not None:
+            self._on_item_expired(key, it)
+
+    def on_executor_died(self, ex: Executor, exc: BaseException,
+                         pending: List[Chunk],
+                         failed: Optional[Chunk]) -> None:
+        """One executor down: fail its in-flight batch, reroute its
+        pending chunks to survivors (fail-all only when none remain)."""
+        if failed is not None:
+            bucket_s = "x".join(str(d) for d in failed.bucket)
+            err = SlateError(
+                f"serve: executor {ex.name} worker thread died "
+                f"({type(exc).__name__}: {exc})")
+            _fail_batch(failed.items, failed.routine, bucket_s,
+                        self.policy.round_batch(len(failed.items)), exc,
+                        ex.flight, reason="worker_death",
+                        resolve_error=err, executor=ex.name)
+            self.chunk_done(ex, failed)
+        survivors = self.alive()
+        if survivors:
+            rerouted = 0
+            for chunk in pending:
+                try:
+                    self.dispatch(chunk)
+                    rerouted += 1
+                except SlateError:
+                    # the survivor died between alive() and enqueue: the
+                    # recursive death handling reroutes or fails-all
+                    self._strand(exc, [chunk])
+            if rerouted:
+                _obs().counter(
+                    "slate_serve_requeued_chunks_total",
+                    "chunks rerouted off a dying executor").inc(
+                        executor=ex.name)
+            if self._on_executor_death is not None:
+                self._on_executor_death(len(survivors),
+                                        len(self.executors), exc)
+        else:
+            self._strand(exc, pending)
+
+    def _strand(self, exc: BaseException, chunks: List[Chunk]) -> None:
+        items = [it for ch in chunks for it in ch.items]
+        if self._on_all_dead is not None:
+            self._on_all_dead(exc, items)

@@ -70,6 +70,7 @@ class FlightRecord:
     #: the rejection-breakdown table in tools/obs_report.py groups on it
     reason: Optional[str] = None
     deadline_s: Optional[float] = None       # submitted deadline budget
+    executor: str = ""                       # serving executor (ex0, ex1, …)
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

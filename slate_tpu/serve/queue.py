@@ -30,68 +30,58 @@ Overload discipline (ROADMAP item 2(c), built on :mod:`.admission`):
 (``interactive`` > ``batch`` > ``best_effort``) with an optional deadline
 budget.  Admission is bounded — per-lane depth, global in-flight, token
 buckets, SLO-coupled shedding — and rejects with a typed
-:class:`~slate_tpu.core.exceptions.QueueOverloadError`.  The flush loop
+:class:`~slate_tpu.core.exceptions.QueueOverloadError`.  The scheduler
 serves ready buckets in (lane priority, earliest deadline) order, flushes a
 bucket *early* when its oldest deadline is within the bucket's observed
 execute-p99, and expires still-queued past-deadline tickets with
 :class:`~slate_tpu.core.exceptions.DeadlineExceededError` before they waste
-a batch slot.  A dead worker thread fails queued tickets fast instead of
-letting ``result()`` hang; every rejection leaves a flight record with its
-reason (``shed`` / ``deadline`` / ``worker_death``).
+a batch slot.  Every rejection leaves a flight record with its reason
+(``shed`` / ``deadline`` / ``worker_death``).
+
+Execution (PR 8, :mod:`.executor`): the queue's scheduler thread no longer
+runs batches itself — it pops one highest-priority bucket chunk per cycle
+and routes it to an :class:`~slate_tpu.serve.executor.ExecutorPool`
+(``executors=N``): cache-residency-first routing with least-loaded fallback
+and work-stealing, and a dispatch/resolve split inside each executor so
+padding of batch k+1 overlaps device execution of batch k.  Admission
+capacity scales with the live executor count (an executor death re-rates
+the token buckets via
+:meth:`~slate_tpu.serve.admission.AdmissionController.scale_capacity`); a
+dying executor fails only its in-flight batch and reroutes the rest, and
+only the death of the LAST executor makes the whole queue fail-fast (every
+queued ticket resolves with a typed error instead of hanging).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import itertools
-import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax.numpy as jnp
 
-from ..core.exceptions import (DeadlineExceededError, NumericalError,
-                               QueueOverloadError, SingularMatrixError,
+from ..core.exceptions import (DeadlineExceededError, QueueOverloadError,
                                SlateError, slate_assert)
 from ..core.types import Options
-from ..robust.faults import inject_serve
 from ..utils import trace
 from . import batched as _batched
 from .admission import AdmissionController, DEFAULT_LANE, LANE_PRIORITY
 from .cache import ExecutableCache, default_cache
-from .flight import FlightRecord, FlightRecorder
-
-#: queue-able routines -> batched driver
-DRIVERS = {
-    "gesv": _batched.gesv_batched,
-    "posv": _batched.posv_batched,
-    "gels": _batched.gels_batched,
-}
-
-_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
-
-#: stage-latency histogram bounds — serving stages live in the us..s range,
-#: far below the registry default's multi-minute top end
-_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
-                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
-
-#: the serving-fault injection site (robust.FaultSpec(driver=SERVE_SITE,
-#: kind="slow_executor" | "worker_crash" | "cache_flush"))
-SERVE_SITE = "serve_batch"
+from .flight import FlightRecorder
+# the batch machinery lives in .executor since the pool split; these are
+# re-exported here because they are queue API surface (and tests/tools
+# import them from this module)
+from .executor import (  # noqa: F401 - re-exported queue API
+    DRIVERS, SERVE_SITE, _OCCUPANCY_BUCKETS, _STAGE_BUCKETS, Chunk,
+    Executor, ExecutorPool, Ticket, _Pending, _capped_error,
+    _flight_record, _new_trace_id, _run_bucket_batch, _stage_hist,
+    executable_key, pad_request, unpad_result)
 
 #: execute-p99 lookups for the early-flush check are cached this long
 _P99_TTL_S = 0.5
-
-_TRACE_SEQ = itertools.count(1)
-
-
-def _new_trace_id(routine: str) -> str:
-    """Process-unique request trace id (stitches one request's spans,
-    ladder events, and flight record across the chrome-trace)."""
-    return f"{routine}-{os.getpid():x}-{next(_TRACE_SEQ):06d}"
 
 
 def _obs():
@@ -105,6 +95,27 @@ def _pow2_at_least(n: int) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+def _merged_quantile(h, q: float, **labels) -> Optional[float]:
+    """``q``-quantile of every series of ``h`` whose labels CONTAIN
+    ``labels`` (subset match, vs :meth:`Histogram.quantile`'s exact match).
+    The execute histogram carries per-executor series under the pool plus
+    unlabeled series from the sync packer; the early-flush threshold wants
+    the (routine, bucket) distribution across all of them."""
+    want = set((str(k), str(v)) for k, v in labels.items())
+    merged: Optional[List[int]] = None
+    for key, state in h.series().items():
+        if not want.issubset(set(key)):
+            continue
+        counts = state["counts"]
+        merged = (list(counts) if merged is None
+                  else [a + b for a, b in zip(merged, counts)])
+    if merged is None:
+        return None
+    from ..obs.registry import quantile_from_counts
+
+    return quantile_from_counts(h.buckets, merged, q)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,102 +173,6 @@ class BucketPolicy:
         return bm, bn, br
 
 
-def pad_request(routine: str, a, b, bucket: Tuple[int, int, int]):
-    """Embed one request into its bucket shape, solution-preserving.
-
-    Square solves: ``A' = [[A, 0], [0, I]]``, ``b' = [b; 0]`` — the padded
-    block solves ``I z = 0`` (SPD-preserving for posv).  Least squares: the
-    same block embedding, with the identity carried on the padded rows x
-    padded cols corner so the padded normal equations are block-diagonal
-    (tall) / the padded minimum-norm system fixes z = 0 (wide)."""
-    bm, bn, br = bucket
-    m, n = a.shape[-2:]
-    nrhs = b.shape[-1]
-    pm, pn = bm - m, bn - n
-    # host-side numpy: the per-request pad must not cost an eager device
-    # dispatch per operand (the packer touches thousands of requests/sec)
-    ap = np.zeros((bm, bn), dtype=np.asarray(a).dtype)
-    ap[:m, :n] = np.asarray(a)
-    k = min(pm, pn)
-    if k:
-        # the identity block at (m, n); leftover padded rows (tall LS) or
-        # cols (wide LS) stay zero — the Gram/QR stays nonsingular because
-        # the identity covers the smaller padding side exactly
-        ap[m + np.arange(k), n + np.arange(k)] = 1
-    bp = np.zeros((bm, br), dtype=np.asarray(b).dtype)
-    bp[:m, :nrhs] = np.asarray(b)
-    return ap, bp
-
-
-def unpad_result(x, n: int, nrhs: int):
-    return x[..., :n, :nrhs]
-
-
-class Ticket:
-    """Async handle for one submitted request.
-
-    Beyond the result, a ticket carries the request's telemetry: a
-    process-unique ``trace_id`` (every span/event of this request in the
-    chrome-trace carries it), per-stage latencies in ``stages``
-    (submit / queue_wait / pad / cache / execute / resolve, seconds),
-    the executable-cache verdict (``cache_hit``), and the escalation-ladder
-    rungs taken (``ladder`` / ``exhausted``) — the same fields the flight
-    recorder persists.  The overload contract adds ``lane`` (priority lane)
-    and ``deadline_s`` / ``t_deadline`` (the submitted budget and its
-    absolute ``perf_counter`` expiry; None = no deadline).
-    """
-
-    __slots__ = ("routine", "shape", "_event", "_value", "_error",
-                 "t_submit", "t_submit_unix", "latency_s", "trace_id",
-                 "stages", "cache_hit", "ladder", "exhausted",
-                 "lane", "deadline_s", "t_deadline")
-
-    def __init__(self, routine: str, shape, lane: str = DEFAULT_LANE,
-                 deadline: Optional[float] = None):
-        self.routine = routine
-        self.shape = shape
-        self._event = threading.Event()
-        self._value = None
-        self._error: Optional[BaseException] = None
-        self.t_submit = time.perf_counter()
-        self.t_submit_unix = time.time()
-        self.latency_s: Optional[float] = None
-        self.trace_id = _new_trace_id(routine)
-        self.stages: Dict[str, float] = {}
-        self.cache_hit: Optional[bool] = None
-        self.ladder: Tuple[str, ...] = ()
-        self.exhausted = False
-        self.lane = lane
-        self.deadline_s = None if deadline is None else float(deadline)
-        self.t_deadline = (None if deadline is None
-                           else self.t_submit + float(deadline))
-
-    def done(self) -> bool:
-        return self._event.is_set()
-
-    def result(self, timeout: Optional[float] = None):
-        """Block until solved; returns ``(x, info)`` (x unpadded)."""
-        if not self._event.wait(timeout):
-            raise TimeoutError(f"{self.routine} request not served within "
-                               f"{timeout}s")
-        if self._error is not None:
-            raise self._error
-        return self._value
-
-    def _resolve(self, value=None, error: Optional[BaseException] = None):
-        self.latency_s = time.perf_counter() - self.t_submit
-        self._value, self._error = value, error
-        self._event.set()
-
-
-class _Pending:
-    __slots__ = ("ticket", "a", "b", "n", "nrhs")
-
-    def __init__(self, ticket, a, b, n, nrhs):
-        self.ticket, self.a, self.b = ticket, a, b
-        self.n, self.nrhs = n, nrhs
-
-
 def _normalize_request(policy: BucketPolicy, routine: str, a, b,
                        lane: str = DEFAULT_LANE,
                        deadline: Optional[float] = None
@@ -290,241 +205,6 @@ def _normalize_request(policy: BucketPolicy, routine: str, a, b,
     return (routine, bucket, str(a.dtype)), item
 
 
-def _stage_hist(obs, name: str, help: str):
-    return obs.histogram(name, help, buckets=_STAGE_BUCKETS)
-
-
-def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
-                   n_real: int, error: Optional[str] = None,
-                   reason: Optional[str] = None) -> FlightRecord:
-    tk = it.ticket
-    info = None
-    if error is None and tk._value is not None:
-        info = int(tk._value[1])
-    return FlightRecord(
-        trace_id=tk.trace_id, routine=routine, bucket=bucket_s,
-        dtype=str(it.a.dtype), t_submit_unix=tk.t_submit_unix,
-        stages=dict(tk.stages), info=info, cache_hit=tk.cache_hit,
-        batch=nb, occupancy=n_real / max(nb, 1), ladder=tk.ladder,
-        exhausted=tk.exhausted, error=error, lane=tk.lane, reason=reason,
-        deadline_s=tk.deadline_s)
-
-
-def _capped_error(routine: str, info: int) -> NumericalError:
-    """The typed error a capped-escalation element resolves with: its own
-    numerical failure class, annotated with why no ladder ran (``info==0``
-    means the verdict tripped on a non-finite payload, not a pivot)."""
-    what = f"info={info}" if info else "non-finite result"
-    msg = (f"serve: {routine} element failed ({what}) and the per-window "
-           "escalation budget was exhausted — no ladder re-run")
-    if info > 0:
-        return SingularMatrixError(msg, info=info)
-    return NumericalError(msg)
-
-
-def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
-                      items: Sequence[_Pending], opts: Options,
-                      cache: ExecutableCache, policy: BucketPolicy,
-                      flight: Optional[FlightRecorder] = None,
-                      esc_gate: Optional[Callable[[int], int]] = None
-                      ) -> None:
-    """Pad + pack one bucket's requests, run the batched driver, distribute.
-
-    Stage decomposition (per request, into ``ticket.stages`` + the
-    ``slate_serve_*_seconds`` histograms + synthesized chrome-trace spans):
-    queue_wait (submit -> batch start, per request), pad (host-side pack),
-    cache (executable lookup + possible compile, from the cache's per-call
-    probe), execute (dispatch + compute + verdict sync, the driver call with
-    the cache share subtracted), resolve (unpad + ticket delivery).
-
-    ``esc_gate`` (the queue's escalation budget) caps how many failed
-    elements may ladder-re-run; capped elements resolve with their typed
-    numerical error.  Serving chaos (an active
-    :class:`~slate_tpu.robust.FaultPlan` with ``serve``-point specs at
-    :data:`SERVE_SITE`) fires here, before the batch executes:
-    ``slow_executor`` stalls, ``cache_flush`` wipes the executable cache,
-    ``worker_crash`` raises — which in the async queue kills the worker
-    thread and exercises the fail-fast path.
-    """
-    obs = _obs()
-    bucket_s = "x".join(str(d) for d in bucket)
-    labels = {"routine": routine, "bucket": bucket_s}
-    for spec in inject_serve(SERVE_SITE):
-        if spec.kind == "slow_executor":
-            time.sleep(spec.delay_s)
-        elif spec.kind == "cache_flush":
-            cache.drop()
-            obs.counter("slate_serve_cache_flushes_total",
-                        "chaos-injected executable-cache wipes").inc(**labels)
-        elif spec.kind == "worker_crash":
-            # deliberately NOT a SlateError: simulates an unexpected crash
-            # (the class the worker-death handler must survive)
-            raise RuntimeError("chaos: injected worker crash")
-    t0 = time.perf_counter()
-    nb = policy.round_batch(len(items))
-    for it in items:                      # stage: queue wait (per request)
-        wait = t0 - it.ticket.t_submit
-        it.ticket.stages["queue_wait"] = wait
-        _stage_hist(obs, "slate_serve_queue_wait_seconds",
-                    "submit-to-batch-start wait per request").observe(
-                        wait, routine=routine)
-    escal: Dict[int, Dict[str, Any]] = {}
-    t_pad0 = t_pad1 = t_exec1 = None
-    cache_s = 0.0
-    cache_info = None
-    res_spans: List[Tuple[float, float]] = []
-    prev_gate = _batched.set_escalation_gate(esc_gate)
-    try:
-        t_pad0 = time.perf_counter()      # stage: pad + pack
-        padded = [pad_request(routine, it.a, it.b, bucket) for it in items]
-        if len(padded) < nb:
-            # ghost batch slots are well-posed identity systems (I x = 0;
-            # SPD, full-rank — valid for all three routines), NOT copies of
-            # the last request: a failing real element must not multiply
-            # its own failure across the pad and burn escalation budget /
-            # ladder re-runs on ghosts
-            ghost = (np.eye(bucket[0], bucket[1], dtype=padded[0][0].dtype),
-                     np.zeros((bucket[0], bucket[2]),
-                              dtype=padded[0][1].dtype))
-            padded += [ghost] * (nb - len(padded))
-        # one host->device transfer per packed operand, not one per request
-        A = jnp.asarray(np.stack([p[0] for p in padded]))
-        B = jnp.asarray(np.stack([p[1] for p in padded]))
-        t_pad1 = time.perf_counter()
-        _stage_hist(obs, "slate_serve_pad_seconds",
-                    "host-side pad+pack time per batch").observe(
-                        t_pad1 - t_pad0, **labels)
-        # stage: cache + execute.  The batch-level span blocks on the device
-        # result before closing (device_sync) so async dispatch cannot
-        # masquerade as compute time; the per-element escalation below the
-        # driver sees the owning request ids via the batch scope.
-        with trace.batch_request_scope([it.ticket.trace_id for it in items]):
-            # ("routine" is scope()'s span-name slot; the serving routine
-            # rides as the "driver" label instead)
-            with obs.scope("serve.execute_batch", device_sync=True,
-                           driver=routine, bucket=bucket_s) as sp:
-                out = DRIVERS[routine](A, B, opts, cache=cache)
-                x, info = out[0], out[-1]
-                sp.set_result(x)
-            escal = _batched.last_escalations()
-        t_exec1 = time.perf_counter()
-        cache_info = cache.last_lookup()
-        cache_s = (cache_info or {}).get("seconds", 0.0)
-        exec_s = max(t_exec1 - t_pad1 - cache_s, 0.0)
-        _stage_hist(obs, "slate_serve_execute_seconds",
-                    "device execute time per batch (cache share "
-                    "subtracted, result blocked on)").observe(
-                        exec_s, **labels)
-        xs = np.asarray(x)
-        infos = np.asarray(info)
-        t_res = time.perf_counter()       # stage: unpad + resolve
-        for i, it in enumerate(items):
-            tk = it.ticket
-            tk.stages["pad"] = t_pad1 - t_pad0
-            tk.stages["cache"] = cache_s
-            tk.stages["execute"] = exec_s
-            tk.cache_hit = (cache_info or {}).get("hit")
-            capped = False
-            e = escal.get(i)
-            if e is not None:
-                tk.ladder = tuple(e["rungs"])
-                tk.exhausted = not e["recovered"]
-                capped = bool(e.get("capped"))
-            if int(infos[i]) != 0:
-                tk.exhausted = True
-            # per-request interval: this request's OWN unpad, stamped before
-            # delivery so the waiter sees a complete stage map (only the
-            # Event.set itself falls outside the measured interval)
-            value = (unpad_result(xs[i], it.n, it.nrhs), int(infos[i]))
-            now = time.perf_counter()
-            tk.stages["resolve"] = now - t_res
-            res_spans.append((t_res, now))
-            t_res = now
-            # a capped element is bad by info OR by finiteness (the same
-            # verdict that queued it for escalation — an overflowed payload
-            # can carry info==0)
-            if capped and (int(infos[i]) != 0
-                           or not np.all(np.isfinite(xs[i]))):
-                # the graceful-degradation contract: a failed element whose
-                # ladder re-run the budget refused resolves with its typed
-                # error (recovered=False), not a silent bad payload
-                tk.exhausted = True
-                tk._resolve(error=_capped_error(routine, int(infos[i])))
-            else:
-                tk._resolve(value)
-    # slate-lint: disable=SLT501 -- not a swallow: the exception (taxonomy
-    # included) is re-surfaced on every pending ticket, whose result() call
-    # re-raises it in the submitter's thread; raising here would instead
-    # kill the queue worker and strand the other buckets
-    except BaseException as e:  # noqa: BLE001 - surfaced on every ticket
-        # the satellite contract: a worker-thread failure is visible in the
-        # registry, the timeline, and the flight recorder — not only through
-        # whichever ticket happens to be awaited first
-        obs.counter("slate_serve_worker_errors_total",
-                    "worker-thread exceptions while serving a batch").inc(
-                        error=type(e).__name__, **labels)
-        trace.trace_event("worker_error", error=type(e).__name__,
-                          **labels)
-        last_rec = None
-        for it in items:
-            if not it.ticket.done():
-                it.ticket._resolve(error=e)
-            if flight is not None:
-                last_rec = _flight_record(it, routine, bucket_s, nb,
-                                          len(items),
-                                          error=f"{type(e).__name__}: {e}",
-                                          reason="worker_error")
-                flight.record(last_rec)
-        if flight is not None and last_rec is not None:
-            flight.on_exhaustion(last_rec, reason="worker_error")
-        return
-    finally:
-        _batched.set_escalation_gate(prev_gate)
-        obs.counter("slate_serve_batches_total",
-                    "executed batches").inc(**labels)
-        obs.histogram("slate_serve_batch_occupancy",
-                      "real requests / padded batch slots",
-                      buckets=_OCCUPANCY_BUCKETS).observe(
-                          len(items) / max(nb, 1), **labels)
-        obs.histogram("slate_serve_batch_seconds",
-                      "wall time per executed batch").observe(
-                          time.perf_counter() - t0, **labels)
-    exhausted_rec = None
-    for i, it in enumerate(items):
-        tk = it.ticket
-        # the lane label is what lane-level latency SLOs (the overload
-        # soak's interactive-p99 objective) filter on; per-routine SLOs
-        # still subset-match on routine alone
-        _stage_hist(obs, "slate_serve_latency_seconds",
-                    "submit-to-result latency per request").observe(
-                        tk.latency_s, routine=routine, lane=tk.lane)
-        if trace.is_on():
-            # retrospective per-request stage spans: one request's lifeline,
-            # stitchable from the interleaved timeline by args.trace_id
-            common = {"trace_id": tk.trace_id, "routine": routine,
-                      "bucket": bucket_s}
-            trace.emit_span("serve.queue_wait", tk.t_submit, t0, **common)
-            trace.emit_span("serve.pad", t_pad0, t_pad1, **common)
-            trace.emit_span("serve.cache", t_pad1, t_pad1 + cache_s,
-                            hit=tk.cache_hit, **common)
-            trace.emit_span("serve.execute", t_pad1 + cache_s, t_exec1,
-                            **common)
-            trace.emit_span("serve.resolve", *res_spans[i], **common)
-        if flight is not None:
-            err_s = (f"{type(tk._error).__name__}: {tk._error}"
-                     if tk._error is not None else None)
-            rec = _flight_record(it, routine, bucket_s, nb, len(items),
-                                 error=err_s)
-            flight.record(rec)
-            if tk.exhausted:
-                exhausted_rec = rec
-    if flight is not None and exhausted_rec is not None:
-        # one dump per batch, after every record is in the ring — a batch of
-        # 32 failing elements must not rewrite the ring file 32 times on the
-        # serving worker thread (the worker-error path dedupes the same way)
-        flight.on_exhaustion(exhausted_rec)
-
-
 class ServeQueue:
     """Mixed-traffic serving queue over the batched drivers.
 
@@ -536,15 +216,22 @@ class ServeQueue:
 
         t = q.submit("gesv", a, b, lane="best_effort", deadline=0.5)
 
-    A background worker packs pending requests per (lane, routine, bucket,
-    dtype) and flushes on ``max_batch`` / ``max_wait_ms`` (see
+        q = serve.ServeQueue(executors=4)       # the multi-executor pool
+
+    A background scheduler packs pending requests per (lane, routine,
+    bucket, dtype), flushes on ``max_batch`` / ``max_wait_ms`` (see
     :class:`BucketPolicy`) in (lane priority, earliest deadline) order —
-    early when a deadline is within the bucket's observed execute-p99.
-    ``admission`` (an :class:`~slate_tpu.serve.admission.AdmissionPolicy`
-    or a pre-built controller) bounds what gets in; rejected submissions
-    raise :class:`QueueOverloadError`, expired tickets resolve with
+    early when a deadline is within the bucket's observed execute-p99 —
+    and routes each popped chunk to the
+    :class:`~slate_tpu.serve.executor.ExecutorPool` (``executors=N``
+    backends, residency-aware, work-stealing, each overlapping host pad
+    with device execute).  ``admission`` (an
+    :class:`~slate_tpu.serve.admission.AdmissionPolicy` or a pre-built
+    controller) bounds what gets in — its capacity re-rates to the live
+    executor fraction on an executor death; rejected submissions raise
+    :class:`QueueOverloadError`, expired tickets resolve with
     :class:`DeadlineExceededError`.  ``close()`` drains and stops the
-    worker; the queue is also a context manager.
+    scheduler + pool; the queue is also a context manager.
     """
 
     def __init__(self, policy: Optional[BucketPolicy] = None,
@@ -552,7 +239,9 @@ class ServeQueue:
                  cache: Optional[ExecutableCache] = None,
                  start: bool = True,
                  flight: Optional[FlightRecorder] = None,
-                 admission: Optional[object] = None):
+                 admission: Optional[object] = None,
+                 executors: int = 1,
+                 steal_threshold: int = 4):
         self.policy = policy or BucketPolicy()
         self.opts = Options.make(opts)
         self.cache = default_cache() if cache is None else cache
@@ -561,6 +250,9 @@ class ServeQueue:
             self.admission = admission
         else:
             self.admission = AdmissionController(admission)
+        if int(executors) < 1:
+            raise SlateError(f"serve: executors must be >= 1, "
+                             f"got {executors}")
         self._slo_monitor = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -570,13 +262,28 @@ class ServeQueue:
         self._min_deadline: Dict[tuple, float] = {}
         self._depths: Dict[str, int] = {}
         self._inflight = 0           # popped off _pending, not yet served
-        self._current_work: List[_Pending] = []
         self._early_ready: set = set()
         self._p99_cache: Dict[Tuple[str, str], Tuple[float, float]] = {}
         self._closed = False
         self._worker_died: Optional[BaseException] = None
         self._worker: Optional[threading.Thread] = None
+        # executor 0 serves from THIS queue's cache (so single-executor
+        # queues keep the exact pre-pool cache identity); extra executors
+        # get their own same-capacity caches — residency is the whole
+        # routing signal, shared tables would erase it
+        caches = [self.cache] + [ExecutableCache(capacity=self.cache.capacity)
+                                 for _ in range(int(executors) - 1)]
+        self.pool = ExecutorPool(
+            int(executors), self.policy, self.opts, caches,
+            flight=self.flight,
+            esc_gate=self.admission.escalations.take,
+            steal_threshold=steal_threshold,
+            on_chunk_done=self._chunk_done,
+            on_item_expired=self._expire_inflight,
+            on_executor_death=self._on_executor_death,
+            on_all_dead=self._on_pool_dead)
         if start:
+            self.pool.start()
             self._worker = threading.Thread(target=self._loop, daemon=True,
                                             name="slate-serve-queue")
             self._worker.start()
@@ -678,9 +385,11 @@ class ServeQueue:
         """Pre-compile every executable the given traffic can need.
 
         ``combos`` is ``(routine, m, n, nrhs)`` request shapes; each maps to
-        its bucket and compiles at *every* batch bucket, so subsequent mixed
-        traffic takes zero cache misses regardless of how flushes split.
-        Returns the number of executables now warm."""
+        its bucket and compiles at *every* batch bucket — in EVERY
+        executor's cache, so subsequent mixed traffic takes zero misses
+        regardless of how flushes split or which executor the router
+        picks.  Returns the number of distinct executables now warm (per
+        cache)."""
         # dedupe first: many request shapes share a bucket, and each
         # (routine, bucket, batch-rung) is one compile
         buckets = sorted({(routine, self.policy.bucket(routine, m, n, nrhs))
@@ -692,24 +401,26 @@ class ServeQueue:
                     continue
                 # the drivers' own builder: a local copy could drift and the
                 # cache key would not notice (it excludes function identity)
-                self.cache.warmup(
-                    routine + "_batched",
-                    _batched.batched_build(routine + "_batched"),
-                    [((nb, bm, bn), dtype), ((nb, bm, br), dtype)],
-                    self.opts)
+                for cache in self.pool.caches():
+                    cache.warmup(
+                        routine + "_batched",
+                        _batched.batched_build(routine + "_batched"),
+                        [((nb, bm, bn), dtype), ((nb, bm, br), dtype)],
+                        self.opts)
                 seen += 1
         return seen
 
-    # -- worker --------------------------------------------------------------
+    # -- scheduler -----------------------------------------------------------
     def _exec_p99(self, routine: str, bucket_s: str, now: float) -> float:
         """Observed execute-stage p99 for one (routine, bucket) — the
-        early-flush threshold — from the PR 6 stage histograms, cached for
-        ``_P99_TTL_S`` so the flush loop stays O(pending keys)."""
+        early-flush threshold — merged across every executor's series of
+        the PR 6 stage histogram, cached for ``_P99_TTL_S`` so the flush
+        loop stays O(pending keys)."""
         ent = self._p99_cache.get((routine, bucket_s))
         if ent is not None and now - ent[1] < _P99_TTL_S:
             return ent[0]
         h = _obs().REGISTRY.get("slate_serve_execute_seconds")
-        q = h.quantile(0.99, routine=routine, bucket=bucket_s) \
+        q = _merged_quantile(h, 0.99, routine=routine, bucket=bucket_s) \
             if h is not None else None
         q = float(q) if q is not None else 0.0
         self._p99_cache[(routine, bucket_s)] = (q, now)
@@ -780,9 +491,11 @@ class ServeQueue:
                                                               _Pending]]:
         """Pull every past-deadline ticket out of EVERY lane's pending
         lists (caller holds the lock; resolution happens outside it).
-        Runs each worker cycle regardless of which bucket wins the pop, so
-        an expired low-lane ticket never waits behind sustained
-        higher-lane traffic — expiry costs no batch slot."""
+        Runs each scheduler cycle regardless of which bucket wins the pop,
+        so an expired low-lane ticket never waits behind sustained
+        higher-lane traffic — expiry costs no batch slot.  (Chunks already
+        routed to an executor get the same sweep at dispatch time, see
+        :meth:`Executor._dispatch`.)"""
         out: List[Tuple[tuple, _Pending]] = []
         for key in [k for k, md in list(self._min_deadline.items())
                     if md <= now]:
@@ -804,7 +517,7 @@ class ServeQueue:
         return out
 
     def _next_wait(self, now: float) -> Optional[float]:
-        """Seconds the worker may sleep before some bucket could become
+        """Seconds the scheduler may sleep before some bucket could become
         ready (None = nothing pending).  Caller holds the lock."""
         wait = None
         for key, items in self._pending.items():
@@ -831,16 +544,28 @@ class ServeQueue:
 
     def _serve_loop(self):
         # one highest-priority bucket chunk per cycle: lane priority and
-        # deadlines are re-evaluated BETWEEN batches, so a deep low-lane
-        # backlog cannot capture the worker for more than one batch while
-        # interactive traffic queues behind it
+        # deadlines are re-evaluated BETWEEN chunks, so a deep low-lane
+        # backlog cannot capture the scheduler while interactive traffic
+        # queues behind it.  The chunk itself executes on the pool — the
+        # scheduler never blocks on a device.
         while True:
             with self._cv:
                 while True:
+                    if self._worker_died is not None:
+                        return           # pool death handler failed tickets
                     now = time.perf_counter()
                     ready = self._ready_keys(now)
-                    if ready or self._closed:
+                    if self._closed:
                         break
+                    if ready:
+                        if self.pool.can_accept():
+                            break
+                        # backpressure: every live executor is at its bound
+                        # — hold the chunk HERE, where lane priority and
+                        # deadline expiry still apply, until a chunk_done
+                        # notify (timeout guards depth read staleness)
+                        self._cv.wait(timeout=0.005)
+                        continue
                     wait = self._next_wait(now)
                     if wait is not None:
                         self._cv.wait(timeout=wait)
@@ -877,29 +602,63 @@ class ServeQueue:
                                 routine=key[1], lane=lane)
                     # popped-but-unserved requests are invisible in
                     # _pending; _inflight keeps flush() honest about them
-                    # (and _current_work lets the death handler fail them
-                    # fast)
+                    # until the pool's chunk_done callback
                     self._inflight += len(live)
-                    self._current_work = list(live)
             for k, it in expired:
                 self._expire(k, it)
             if not live:
                 continue
             try:
-                _run_bucket_batch(
-                    key[1], key[2], live, self.opts, self.cache,
-                    self.policy, flight=self.flight,
-                    esc_gate=self.admission.escalations.take)
-            finally:
+                self.pool.dispatch(Chunk(key, live))
+            # slate-lint: disable=SLT501 -- not a swallow: the routed-but-
+            # undelivered chunk's tickets are failed fast right here, then
+            # the exception re-raises into the worker-death boundary
+            except BaseException as e:  # noqa: BLE001 - resurfaced
+                err = SlateError(f"serve: worker thread died: "
+                                 f"{type(e).__name__}: {e}")
                 with self._cv:
                     self._inflight -= len(live)
-                    # keep unresolved tickets visible: if an exception is
-                    # unwinding this frame, the death handler fails exactly
-                    # these fast (served tickets are done() and drop out)
-                    self._current_work = [
-                        it for it in self._current_work
-                        if not it.ticket.done()]
                     self._cv.notify_all()
+                for it in live:
+                    if not it.ticket.done():
+                        it.ticket._resolve(error=err)
+                raise
+
+    # -- pool callbacks ------------------------------------------------------
+    def _chunk_done(self, chunk: Chunk) -> None:
+        """An executor finished (or failed) one routed chunk: drop it from
+        the in-flight count ``flush()``/admission watch."""
+        with self._cv:
+            self._inflight -= len(chunk.items)
+            self._cv.notify_all()
+
+    def _expire_inflight(self, key: tuple, it: _Pending) -> None:
+        """A routed chunk's item crossed its deadline while queued behind
+        other chunks in an executor — same typed expiry as the in-queue
+        sweep (the executor already took it out of its chunk)."""
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
+        self._expire(key, it)
+
+    def _on_executor_death(self, alive: int, total: int,
+                           exc: BaseException) -> None:
+        """One executor (not the last) died: re-rate admission to the
+        surviving fraction and wake the scheduler (its routing set just
+        changed)."""
+        self.admission.scale_capacity(alive / total)
+        _obs().gauge("slate_serve_executors_alive",
+                     "live executors in the serving pool").set(alive)
+        with self._cv:
+            self._p99_cache.clear()
+            self._cv.notify_all()
+
+    def _on_pool_dead(self, exc: BaseException,
+                      stranded: List[_Pending]) -> None:
+        """The LAST executor died: the whole queue fails fast (PR 7
+        contract) — every queued ticket plus the chunks stranded inside
+        the pool resolve with the typed error now."""
+        self._on_worker_death(exc, extra=stranded)
 
     def _expire(self, key: tuple, it: _Pending) -> None:
         """Resolve one past-deadline ticket with its typed error — before
@@ -920,10 +679,13 @@ class ServeQueue:
             it, routine, bucket_s, 0, 0,
             error=f"{type(err).__name__}: {err}", reason="deadline"))
 
-    def _on_worker_death(self, exc: BaseException) -> None:
-        """The worker thread is gone: fail every queued and in-flight
-        ticket *now* with a typed error instead of letting ``result()``
-        hang to its timeout, and leave counters + flight records behind."""
+    def _on_worker_death(self, exc: BaseException,
+                         extra: Optional[List[_Pending]] = None) -> None:
+        """The serving path is gone (scheduler crash, or the pool's last
+        executor died): fail every queued and in-flight ticket *now* with
+        a typed error instead of letting ``result()`` hang to its timeout,
+        and leave counters + flight records behind.  ``extra`` carries
+        tickets stranded inside the pool (chunks no survivor could take)."""
         obs = _obs()
         obs.counter("slate_serve_worker_deaths_total",
                     "serving worker threads lost to exceptions").inc(
@@ -941,14 +703,12 @@ class ServeQueue:
                 self._depths[lane] = 0
                 self._depth_gauge(lane)
             self._depths.clear()
-            inflight = list(self._current_work)
-            self._current_work = []
             self._inflight = 0
             self._cv.notify_all()
         err = SlateError(f"serve: worker thread died: "
                          f"{type(exc).__name__}: {exc}")
         last_rec = None
-        victims = [it for _, it in stranded] + inflight
+        victims = [it for _, it in stranded] + list(extra or [])
         for it in victims:
             if not it.ticket.done():
                 it.ticket._resolve(error=err)
@@ -963,6 +723,16 @@ class ServeQueue:
             self.flight.on_exhaustion(last_rec, reason="worker_death")
 
     # -- telemetry -----------------------------------------------------------
+    def capacity_fraction(self) -> float:
+        """Live executors / configured executors — 1.0 while healthy; the
+        overload harness re-derives its offered-load target from this when
+        chaos shrinks the pool mid-run."""
+        return self.pool.alive_count() / max(self.pool.size(), 1)
+
+    def executor_depths(self) -> Dict[str, int]:
+        """Queued + in-flight chunk count per executor (point-in-time)."""
+        return {ex.name: ex.depth() for ex in self.pool.executors}
+
     def dump_flight(self, path: Optional[str] = None) -> str:
         """Write the flight recorder's ring as JSON (on-demand postmortem);
         returns the path."""
@@ -999,11 +769,11 @@ class ServeQueue:
     # -- lifecycle -----------------------------------------------------------
     def flush(self, timeout: float = 30.0) -> None:
         """Block until everything pending at call time has been SERVED —
-        not merely popped off the queue (tickets resolved, metrics
+        not merely routed to an executor (tickets resolved, metrics
         recorded)."""
         deadline = time.monotonic() + timeout
         with self._cv:
-            self._cv.notify_all()      # wake the worker for age-based flushes
+            self._cv.notify_all()  # wake the scheduler for age-based flushes
             while any(self._pending.values()) or self._inflight:
                 if self._worker_died is not None:
                     return             # death handler already failed tickets
@@ -1013,12 +783,16 @@ class ServeQueue:
                 self._cv.wait(timeout=min(left, 0.05))
 
     def close(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout)
             self._worker = None
+        # the scheduler drained _pending into the pool before exiting; the
+        # pool drains each executor's queued + in-flight chunks
+        self.pool.close(max(deadline - time.monotonic(), 0.1))
 
     def __enter__(self) -> "ServeQueue":
         return self

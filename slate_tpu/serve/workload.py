@@ -77,6 +77,19 @@ def _percentile_ms(lat_s: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(lat_s), q) * 1e3)
 
 
+def _pool_cache_stats(q: ServeQueue) -> Dict[str, int]:
+    """Hit/miss/eviction totals summed across every executor's cache —
+    the pool-wide version of ``ExecutableCache.stats()`` (identical to it
+    at ``executors=1``, where the pool serves from the queue's own
+    cache)."""
+    agg = {"hits": 0, "misses": 0, "evictions": 0, "size": 0}
+    for c in q.pool.caches():
+        s = c.stats()
+        for k in agg:
+            agg[k] += s[k]
+    return agg
+
+
 def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                        policy: Optional[BucketPolicy] = None,
                        opts: Optional[Options] = None,
@@ -87,6 +100,7 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                        check: bool = True,
                        flight: Optional[FlightRecorder] = None,
                        return_tickets: bool = False,
+                       executors: int = 1,
                        after_warmup: Optional[Callable[[ServeQueue], None]]
                        = None) -> Dict[str, Any]:
     """Generate, warm up, and serve a mixed workload; return the stats dict.
@@ -104,7 +118,11 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
     warm-up sweep and the measured pass (start a sampler / enable tracing
     there, so warm-up compiles stay out of the steady-state windows);
     ``return_tickets=True`` adds the queue pass's tickets to the stats
-    (trace-stitch checks need their trace ids and stage maps)."""
+    (trace-stitch checks need their trace ids and stage maps).
+
+    ``executors=N`` serves through an N-executor pool (the serve_scale
+    bench axis); cache stats and the zero-miss-after-warmup gate aggregate
+    across every executor's cache."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = ExecutableCache()
@@ -113,14 +131,15 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                      for r, a, b in reqs})
 
     q = ServeQueue(policy=policy, opts=opts, cache=cache, start=use_queue,
-                   flight=flight)
+                   flight=flight, executors=executors)
     warm_stats = None
     if warm:
         t0 = time.perf_counter()
         q.warmup(combos, dtype=reqs[0][1].dtype)
         warm_stats = {"seconds": round(time.perf_counter() - t0, 3),
-                      **cache.stats()}
-    miss0, hit0 = cache.misses, cache.hits
+                      **_pool_cache_stats(q)}
+    pool0 = _pool_cache_stats(q)
+    miss0, hit0 = pool0["misses"], pool0["hits"]
     if after_warmup is not None:
         after_warmup(q)
 
@@ -149,6 +168,7 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
     buckets = sorted({"x".join(map(str, policy.bucket(r, a.shape[0],
                                                       a.shape[1], b.shape[1])))
                       for r, a, b in reqs})
+    pool1 = _pool_cache_stats(q)
     stats: Dict[str, Any] = {
         "requests": len(reqs),
         "wall_s": round(wall, 4),
@@ -157,9 +177,11 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
         "buckets": buckets,
         "routines": sorted(set(r for r, _, _ in reqs)),
         "bad": bad,
-        "cache": cache.stats(),
-        "misses_after_warmup": cache.misses - miss0,
-        "hits_measured": cache.hits - hit0,
+        "executors": int(executors),
+        "steals": q.pool.steals,
+        "cache": pool1,
+        "misses_after_warmup": pool1["misses"] - miss0,
+        "hits_measured": pool1["hits"] - hit0,
         "warmup": warm_stats,
     }
     if latencies:
@@ -220,7 +242,8 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
                           flight: Optional[FlightRecorder] = None,
                           after_warmup: Optional[Callable[[ServeQueue], None]]
                           = None,
-                          drain_timeout_s: float = 60.0) -> Dict[str, Any]:
+                          drain_timeout_s: float = 60.0,
+                          executors: int = 1) -> Dict[str, Any]:
     """Drive the serving queue past its measured capacity; return the tally.
 
     Three phases: (1) warm up every executable and *measure* capacity with
@@ -237,7 +260,13 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
     ``after_warmup(q)`` runs between calibration and the overload pass
     (attach the SLO monitor / start the sampler there).  The returned stats
     carry the measured capacity, the offered rate, per-lane submit/shed/
-    expire/ok counts, latency p50/p99 per lane, and ``hung``."""
+    expire/ok counts, latency p50/p99 per lane, and ``hung``.
+
+    ``executors=N`` serves through an N-executor pool; nominal capacity
+    (and the offered rate sized from it) scales by N, and the arrival loop
+    RE-calibrates mid-run when the pool shrinks — a chaos-killed executor
+    drops :meth:`ServeQueue.capacity_fraction`, the offered rate follows,
+    and ``recalibrations`` counts the adjustments."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = ExecutableCache()
@@ -251,11 +280,18 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
     warm_q.warmup(combos, dtype=reqs[0][1].dtype)
     warmup_s = time.perf_counter() - t0
     warm_q.close()
-    capacity = measure_capacity(warm_q, reqs[:calibrate_requests], opts=opts)
+    # single-executor warm throughput; the pool's nominal capacity scales
+    # linearly with N (recalibrated live by capacity_fraction below)
+    capacity1 = measure_capacity(warm_q, reqs[:calibrate_requests], opts=opts)
+    capacity = capacity1 * int(executors)
 
     admission = admission or default_overload_admission(capacity)
     q = ServeQueue(policy=policy, opts=opts, cache=cache, flight=flight,
-                   admission=admission)
+                   admission=admission, executors=executors)
+    if int(executors) > 1:
+        # the extra executors' caches are cold — warm them too, before the
+        # measured window opens (executor 0 re-warms as pure hits)
+        q.warmup(combos, dtype=reqs[0][1].dtype)
     if after_warmup is not None:
         after_warmup(q)
 
@@ -273,12 +309,23 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
     shed_reasons: Dict[str, int] = {}
     per_lane_submit: Dict[str, int] = {lane: 0 for lane in LANES}
     aborted: Optional[str] = None
+    frac = q.capacity_fraction()
+    recalibrations = 0
     t_start = time.perf_counter()
     t_next = t_start
     n = 0
     try:
         while (time.perf_counter() - t_start) < duration_s \
                 and n < max_requests:
+            f = q.capacity_fraction()
+            if f != frac:
+                # the pool changed size under us (executor death): re-size
+                # the offered load to the surviving capacity so the soak
+                # keeps measuring overload, not a stampede on a half pool
+                frac = f
+                target_rate = max(capacity_factor * capacity * frac, 1.0)
+                xm = (alpha - 1) / (alpha * target_rate)
+                recalibrations += 1
             routine, a, b = reqs[int(rng.integers(len(reqs)))]
             lane = str(lanes[int(rng.choice(len(lanes), p=weights))])
             per_lane_submit[lane] = per_lane_submit.get(lane, 0) + 1
@@ -337,6 +384,9 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
 
     stats: Dict[str, Any] = {
         "capacity_solves_per_sec": round(capacity, 1),
+        "executors": int(executors),
+        "capacity_fraction_final": round(q.capacity_fraction(), 3),
+        "recalibrations": recalibrations,
         "target_rate": round(target_rate, 1),
         "offered": n,
         "offered_rate": round(n / max(offered_s, 1e-9), 1),
@@ -351,9 +401,33 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
         "aborted": aborted,
         "submitted_by_lane": {k: v for k, v in per_lane_submit.items() if v},
         "hung": hung,
-        "cache": cache.stats(),
+        "cache": _pool_cache_stats(q),
     }
     for lane, lats in sorted(lat_by_lane.items()):
         stats[f"{lane}_p50_ms"] = round(_percentile_ms(lats, 50), 3)
         stats[f"{lane}_p99_ms"] = round(_percentile_ms(lats, 99), 3)
     return stats
+
+
+def run_scale_workload(executor_counts: Sequence[int] = (1, 2, 4),
+                       num_requests: int = 600, seed: int = 0,
+                       policy: Optional[BucketPolicy] = None,
+                       opts: Optional[Options] = None,
+                       **kwargs) -> Dict[str, Any]:
+    """The serve_scale bench axis: the same warm mixed stream served at
+    each pool size, so N=1 vs N=2 vs N=4 throughput is an apples-to-apples
+    read (same seed, same policy, fresh caches per run).  Extra keyword
+    args pass through to :func:`run_mixed_workload`.  Returns per-N stats
+    plus a ``solves_per_sec`` summary keyed by executor count."""
+    runs: Dict[str, Any] = {}
+    for n in executor_counts:
+        stats = run_mixed_workload(num_requests=num_requests, seed=seed,
+                                   policy=policy, opts=opts,
+                                   executors=int(n), **kwargs)
+        stats.pop("tickets", None)       # not JSON-serializable
+        runs[str(int(n))] = stats
+    return {
+        "executor_counts": [int(n) for n in executor_counts],
+        "runs": runs,
+        "solves_per_sec": {k: v["solves_per_sec"] for k, v in runs.items()},
+    }

@@ -1,0 +1,281 @@
+"""Multi-executor serving pool (slate_tpu.serve.executor): cross-pool-size
+bit-identity, residency-aware routing (pinned via compile counters),
+work-stealing under a skewed mix, drain-and-reroute on a single executor
+death (zero hung tickets), deadline expiry + lane priority under the pool,
+and the capacity-rescaling plumbing (TokenBucket.set_rate,
+AdmissionController.scale_capacity)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu import obs, robust, serve
+from slate_tpu.core.exceptions import DeadlineExceededError, SlateError
+from slate_tpu.serve.admission import AdmissionController, AdmissionPolicy
+from slate_tpu.serve.admission import TokenBucket
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.executor import SERVE_SITE, executable_key
+from slate_tpu.serve.queue import BucketPolicy, ServeQueue
+
+
+def _dd(n, seed=0):
+    a = np.random.default_rng(seed).standard_normal((n, n)).astype(np.float32)
+    return a + n * np.eye(n, dtype=np.float32)
+
+
+def _rhs(n, nrhs=1, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, nrhs)).astype(np.float32)
+
+
+def _queue(executors, *, max_batch=4, batch_dims=(1, 4), max_wait_ms=500.0,
+           **kw):
+    """A pool queue with a private cache and a chunking-controlled policy:
+    submitting in exact ``max_batch`` groups and awaiting each group forces
+    identical batch sizes regardless of pool size (XLA CPU's vmapped cores
+    are bitwise reproducible per element only at EQUAL batch rounding)."""
+    policy = BucketPolicy(max_batch=max_batch, batch_dims=tuple(batch_dims),
+                          max_wait_ms=max_wait_ms)
+    return ServeQueue(policy=policy, cache=ExecutableCache(),
+                      executors=executors, **kw)
+
+
+class TestPoolBitIdentity:
+    def _serve_groups(self, executors, groups):
+        q = _queue(executors)
+        out = []
+        for g in groups:
+            ts = [q.submit(r, a, b) for r, a, b in g]
+            # await the whole group before offering the next: every pool
+            # size sees the same max_batch-sized chunks in the same order
+            out.append([t.result(timeout=120.0) for t in ts])
+        q.close()
+        return out
+
+    @pytest.mark.parametrize("routine", ["gesv", "posv", "gels"])
+    def test_n_executors_bit_identical_to_one(self, routine):
+        rng = np.random.default_rng(3)
+        groups = []
+        for g in range(3):
+            reqs = []
+            for i in range(4):
+                n = 8
+                if routine == "gels":
+                    a = rng.standard_normal((2 * n, n)).astype(np.float32)
+                    b = rng.standard_normal((2 * n, 1)).astype(np.float32)
+                elif routine == "posv":
+                    g_ = rng.standard_normal((n, n)).astype(np.float32)
+                    a = (g_ @ g_.T + n * np.eye(n)).astype(np.float32)
+                    b = rng.standard_normal((n, 1)).astype(np.float32)
+                else:
+                    a = rng.standard_normal((n, n)).astype(np.float32) \
+                        + n * np.eye(n, dtype=np.float32)
+                    b = rng.standard_normal((n, 1)).astype(np.float32)
+                reqs.append((routine, a, b))
+            groups.append(reqs)
+        ref = self._serve_groups(1, groups)
+        for n_ex in (2, 4):
+            got = self._serve_groups(n_ex, groups)
+            for gr, gg in zip(ref, got):
+                for (xr, ir), (xg, ig) in zip(gr, gg):
+                    assert int(ir) == int(ig) == 0
+                    # BIT-identical, not allclose: same chunking must give
+                    # the same executable semantics on every executor
+                    assert np.asarray(xr).tobytes() == \
+                        np.asarray(xg).tobytes()
+
+
+class TestResidencyRouting:
+    def test_repeat_bucket_sticks_to_compiling_executor(self):
+        q = _queue(2)
+        try:
+            for _ in range(3):           # three identical cold->warm chunks
+                ts = [q.submit("gesv", _dd(8, s), _rhs(8))
+                      for s in range(4)]
+                for t in ts:
+                    assert t.result(timeout=120.0)[1] == 0
+            c0, c1 = q.pool.caches()
+            # first chunk compiled on the least-loaded executor (ex0 by
+            # index tie-break); every later same-bucket chunk followed the
+            # residency index there — the other cache never compiled
+            assert c0.stats()["misses"] == 1
+            assert c0.stats()["hits"] >= 2
+            assert c1.stats()["misses"] == 0
+            key = executable_key(q.policy, q.opts, "gesv",
+                                 q.policy.bucket("gesv", 8, 8, 1),
+                                 "float32", 4)
+            assert q.pool.residency(key) == (0,)
+            assert all(t.executor == "ex0" for t in ts)
+        finally:
+            q.close()
+
+
+class TestWorkStealing:
+    def test_backed_up_resident_executor_loses_chunks(self):
+        # max_batch=1: every request is its own chunk; warm ONLY ex0 so
+        # residency points all traffic there, then overwhelm it
+        n = 64
+        q = _queue(2, max_batch=1, batch_dims=(1,), max_wait_ms=0.0,
+                   steal_threshold=2)
+        try:
+            combos = [("gesv", n, n, 1)]
+            from slate_tpu.serve import batched as _batched
+            bucket = q.policy.bucket("gesv", n, n, 1)
+            q.pool.caches()[0].warmup(
+                "gesv_batched", _batched.batched_build("gesv_batched"),
+                [((1,) + bucket[:2], np.float32),
+                 ((1, bucket[0], bucket[2]), np.float32)], q.opts)
+            steals0 = q.pool.steals
+            ts = [q.submit("gesv", _dd(n, s), _rhs(n, seed=s))
+                  for s in range(40)]
+            for t in ts:
+                assert t.result(timeout=120.0)[1] == 0
+            assert q.pool.steals > steals0
+            served_by = {t.executor for t in ts}
+            assert served_by == {"ex0", "ex1"}
+            c = obs.REGISTRY.get("slate_serve_steals_total")
+            assert c is not None and sum(c.series().values()) >= 1
+        finally:
+            q.close()
+
+
+class TestExecutorDeath:
+    def test_one_death_reroutes_and_pool_survives(self):
+        q = _queue(2, max_batch=4, batch_dims=(1, 4), max_wait_ms=2.0)
+        try:
+            with robust.FaultPlan([robust.FaultSpec(
+                    SERVE_SITE, "worker_crash", executor=0)]):
+                ts = [q.submit("gesv", _dd(8, s), _rhs(8))
+                      for s in range(40)]
+                failed = ok = 0
+                for t in ts:
+                    # ZERO hung tickets: every result() returns or raises
+                    # typed, well before the timeout
+                    try:
+                        _, info = t.result(timeout=60.0)
+                        assert info == 0
+                        ok += 1
+                    except SlateError as e:
+                        assert "worker thread died" in str(e)
+                        failed += 1
+                # only the chunk in flight on the dying executor fails
+                assert 1 <= failed <= 4
+                assert ok == len(ts) - failed
+            assert q.capacity_fraction() == 0.5
+            assert q.admission.capacity_fraction == 0.5
+            # the pool keeps serving on the survivor — submit still works
+            t = q.submit("gesv", _dd(8, 99), _rhs(8))
+            assert t.result(timeout=60.0)[1] == 0
+            assert t.executor == "ex1"
+            c = obs.REGISTRY.get("slate_serve_worker_deaths_total")
+            assert c is not None and any(
+                dict(k).get("executor") == "ex0"
+                for k in c.series())
+        finally:
+            q.close()
+
+    def test_dead_executor_flight_records(self):
+        flight = serve.FlightRecorder(capacity=128)
+        q = ServeQueue(policy=BucketPolicy(max_batch=4, batch_dims=(1, 4),
+                                           max_wait_ms=2.0),
+                       cache=ExecutableCache(), executors=2, flight=flight)
+        try:
+            with robust.FaultPlan([robust.FaultSpec(
+                    SERVE_SITE, "worker_crash", executor=1)]):
+                ts = [q.submit("posv", _dd(8, s) @ _dd(8, s).T
+                               + 8 * np.eye(8, dtype=np.float32), _rhs(8))
+                      for s in range(40)]
+                for t in ts:
+                    try:
+                        t.result(timeout=60.0)
+                    except SlateError:
+                        pass
+            recs = [r for r in flight.records()
+                    if r.reason == "worker_death"]
+            assert recs
+            assert all("worker crash" in r.error for r in recs)
+            assert all(r.executor == "ex1" for r in recs)
+        finally:
+            q.close()
+
+
+class TestDeadlinesAndLanesUnderPool:
+    def test_deadline_expires_behind_stalled_executors(self):
+        specs = [robust.FaultSpec(SERVE_SITE, "slow_executor",
+                                  delay_s=0.4, executor=e) for e in (0, 1)]
+        with robust.FaultPlan(specs):
+            q = _queue(2, max_batch=4, batch_dims=(1, 4), max_wait_ms=2.0)
+            try:
+                # two DIFFERENT routines -> two chunks -> one per executor;
+                # both dispatchers stall on their first chunk
+                t1 = q.submit("gesv", _dd(8), _rhs(8), lane="interactive")
+                spd = _dd(8, 2) @ _dd(8, 2).T + 8 * np.eye(
+                    8, dtype=np.float32)
+                t2 = q.submit("posv", spd, _rhs(8), lane="interactive")
+                time.sleep(0.05)        # both executors now mid-stall
+                tb = q.submit("gesv", _dd(8, 5), _rhs(8),
+                              lane="best_effort", deadline=0.05)
+                with pytest.raises(DeadlineExceededError):
+                    tb.result(timeout=30.0)
+                assert t1.result(timeout=30.0)[1] == 0
+                assert t2.result(timeout=30.0)[1] == 0
+            finally:
+                q.close()
+
+    def test_interactive_overtakes_best_effort_backlog(self):
+        specs = [robust.FaultSpec(SERVE_SITE, "slow_executor",
+                                  delay_s=0.25, executor=e) for e in (0, 1)]
+        with robust.FaultPlan(specs):
+            q = _queue(2, max_batch=1, batch_dims=(1,), max_wait_ms=0.5,
+                       steal_threshold=1)
+            try:
+                # 8 best-effort chunks: both executors fill to their bound
+                # (steal_threshold+2 = 3) while their first dispatch
+                # stalls, leaving a backlog in the scheduler
+                be = [q.submit("gesv", _dd(8, s), _rhs(8),
+                               lane="best_effort") for s in range(8)]
+                time.sleep(0.02)
+                ti = q.submit("gesv", _dd(8, 99), _rhs(8),
+                              lane="interactive")
+                assert ti.result(timeout=60.0)[1] == 0
+                for t in be:
+                    assert t.result(timeout=60.0)[1] == 0
+            finally:
+                q.close()
+        start = lambda t: t.t_submit + t.stages["queue_wait"]
+        # lane priority still decided at the scheduler: the late
+        # interactive chunk reached an executor before the queued tail of
+        # the best-effort backlog
+        assert start(ti) < max(start(t) for t in be)
+
+
+class TestCapacityRescaling:
+    def test_token_bucket_set_rate_refills_at_old_rate_first(self):
+        b = TokenBucket(rate=10.0, burst=100.0, clock=lambda: 0.0)
+        assert b.try_take(100.0, now=0.0)        # drain the full burst
+        # 1s at the OLD rate accrues 10 tokens, then the rate drops; the
+        # next 1s accrues only 1 — set_rate must not retroactively re-price
+        # the elapsed window
+        b.set_rate(1.0, now=1.0)
+        assert b.tokens(now=1.0) == pytest.approx(10.0)
+        assert b.tokens(now=2.0) == pytest.approx(11.0)
+        with pytest.raises(ValueError):
+            b.set_rate(0.0)
+
+    def test_scale_capacity_rescales_from_base_not_compounding(self):
+        ctl = AdmissionController(AdmissionPolicy(
+            rate={"best_effort": 100.0}, burst={"best_effort": 10.0}))
+        ctl.scale_capacity(0.5)
+        assert ctl.capacity_fraction == 0.5
+        assert ctl._buckets["best_effort"].rate == pytest.approx(50.0)
+        ctl.scale_capacity(0.5)                  # idempotent, not 25.0
+        assert ctl._buckets["best_effort"].rate == pytest.approx(50.0)
+        ctl.scale_capacity(1.0)                  # recovery restores base
+        assert ctl._buckets["best_effort"].rate == pytest.approx(100.0)
+        with pytest.raises(ValueError):
+            ctl.scale_capacity(0.0)
+
+    def test_queue_rejects_zero_executors(self):
+        with pytest.raises(SlateError, match="executors"):
+            ServeQueue(executors=0, start=False)

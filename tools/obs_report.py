@@ -111,6 +111,61 @@ def _stage_table(metrics_doc: Dict[str, Any]) -> List[str]:
     return lines + [""]
 
 
+def _counter_sum(metrics_doc: Dict[str, Any], name: str,
+                 **labels: str) -> float:
+    for m in metrics_doc.get("metrics", ()):
+        if m["name"] == name and m["kind"] == "counter":
+            return sum(s["value"] for s in m["samples"]
+                       if all(s.get("labels", {}).get(k) == v
+                              for k, v in labels.items()))
+    return 0.0
+
+
+def _executor_table(metrics_doc: Dict[str, Any]) -> List[str]:
+    """Per-executor utilization: device-busy and pad time from the
+    ``executor``-labelled stage histograms, batch count, cache traffic
+    (hits / misses == compiles) from the owner-labelled cache counters,
+    and each executor's share of the pool's total busy time — the skew
+    view residency-aware routing and work-stealing are audited with."""
+    ex_samples = _hist_samples(metrics_doc, "slate_serve_execute_seconds")
+    pad_samples = _hist_samples(metrics_doc, "slate_serve_pad_seconds")
+    names = sorted({s["labels"]["executor"] for s in ex_samples
+                    if s.get("labels", {}).get("executor")})
+    if not names:
+        return ["_no per-executor samples (single-worker serve path or no "
+                "traffic)_", ""]
+
+    def busy(samples, ex):
+        tot_s = sum(s["sum"] for s in samples
+                    if s.get("labels", {}).get("executor") == ex)
+        n = sum(s["count"] for s in samples
+                if s.get("labels", {}).get("executor") == ex)
+        return tot_s, n
+
+    pool_busy = sum(busy(ex_samples, ex)[0] for ex in names) or 1.0
+    lines = ["| executor | batches | busy (s) | ms/batch | pad (s) "
+             "| cache hit | compile | busy share |",
+             "|---|---|---|---|---|---|---|---|"]
+    for ex in names:
+        b_s, b_n = busy(ex_samples, ex)
+        p_s, _ = busy(pad_samples, ex)
+        hits = _counter_sum(metrics_doc, "slate_serve_cache_hits_total",
+                            executor=ex)
+        miss = _counter_sum(metrics_doc, "slate_serve_cache_misses_total",
+                            executor=ex)
+        per = f"{b_s / b_n * 1e3:.2f}" if b_n else "—"
+        lines.append(f"| `{ex}` | {int(b_n)} | {b_s:.3f} | {per} "
+                     f"| {p_s:.3f} | {int(hits)} | {int(miss)} "
+                     f"| {b_s / pool_busy:.0%} |")
+    steals = _counter_sum(metrics_doc, "slate_serve_steals_total")
+    requeued = _counter_sum(metrics_doc, "slate_serve_requeued_chunks_total")
+    lines += ["", f"({len(names)} executors; {int(steals)} chunks "
+              f"work-stolen, {int(requeued)} requeued by death drains; "
+              "busy share = this executor's device time over the pool's)",
+              ""]
+    return lines
+
+
 def _rate(window: Dict[str, Any], counter: str) -> float:
     return sum(c["rate"] for c in window["counters"]
                if c["name"] == counter)
@@ -238,6 +293,8 @@ def render_report(ts_doc: Dict[str, Any],
     ]
     if metrics_doc is not None:
         md += _stage_table(metrics_doc)
+        md += ["## Per-executor utilization", "",
+               *_executor_table(metrics_doc)]
     else:
         md += ["_no metrics.json supplied_", ""]
     md += ["## Window rates", "", *_window_table(ts_doc),
