@@ -748,9 +748,22 @@ def child_serve_mixed(cpu_fallback):
     small; the axis is queue+cache throughput, not peak flops): warm-up
     compiles every (routine, bucket, batch-bucket) executable, then the
     measured pass must take zero cache misses."""
-    from slate_tpu.serve.workload import run_mixed_workload
+    from slate_tpu.serve.queue import BucketPolicy
+    from slate_tpu.serve.workload import (run_continuous_ab,
+                                          run_mixed_workload)
 
     stats = run_mixed_workload(num_requests=1200, seed=0)
+    # continuous-batching A/B (ROADMAP 2(a)): interleaved flush-vs-
+    # continuous rounds — queue_wait p50 at equal paced load plus the warm
+    # throughput ratio and slot-join rate ride in the metric blob.  A
+    # tight policy bounds the per-run warmup compile bill.
+    ab = None
+    if _budget_left() > 240:
+        ab = run_continuous_ab(
+            num_requests=300, seed=0, rounds=2, executors=2,
+            dims=(8, 13),
+            policy=BucketPolicy(dims=(16, 32), nrhs_dims=(1, 4),
+                                batch_dims=(1, 4, 16), max_batch=16))
     _emit({"metric": "serve_mixed_solves_per_sec",
            "value": stats["solves_per_sec"], "unit": "solves/s",
            "requests": stats["requests"], "wall_s": stats["wall_s"],
@@ -758,7 +771,8 @@ def child_serve_mixed(cpu_fallback):
            "distinct_buckets": stats["distinct_buckets"],
            "routines": stats["routines"],
            "misses_after_warmup": stats["misses_after_warmup"],
-           "cache": stats["cache"], "warmup": stats["warmup"]})
+           "cache": stats["cache"], "warmup": stats["warmup"],
+           "continuous_ab": ab})
 
 
 def child_serve_scale(cpu_fallback):
@@ -775,6 +789,12 @@ def child_serve_scale(cpu_fallback):
                              seed=0)
     sps = out["solves_per_sec"]
     runs = out["runs"]
+    # the continuous axis at N=2: same stream under rolling admission —
+    # eager dispatch + staged merges/joins must hold the warm rate
+    cont = None
+    if _budget_left() > 120:
+        cont = run_scale_workload(executor_counts=(2,), num_requests=900,
+                                  seed=0, continuous=True)["runs"]["2"]
     _emit({"metric": "serve_scale_n2_solves_per_sec",
            "value": sps["2"], "unit": "solves/s",
            "solves_per_sec": sps,
@@ -782,7 +802,13 @@ def child_serve_scale(cpu_fallback):
            "steals": {n: runs[n].get("steals", 0) for n in runs},
            "misses_after_warmup": {
                n: runs[n].get("misses_after_warmup") for n in runs},
-           "p99_ms": {n: runs[n].get("p99_ms") for n in runs}})
+           "p99_ms": {n: runs[n].get("p99_ms") for n in runs},
+           "continuous_n2": None if cont is None else {
+               "solves_per_sec": cont["solves_per_sec"],
+               "slot_joins": cont.get("slot_joins"),
+               "slot_join_rate": cont.get("slot_join_rate"),
+               "queue_wait_p50_ms": cont.get("queue_wait_p50_ms"),
+               "misses_after_warmup": cont.get("misses_after_warmup")}})
 
 
 CHILDREN = {

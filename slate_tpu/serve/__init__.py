@@ -46,8 +46,9 @@ from .executor import Chunk, Executor, ExecutorPool, executable_key
 from .flight import FlightRecord, FlightRecorder, validate_flight
 from .queue import (BucketPolicy, SERVE_SITE, ServeQueue, Ticket,
                     pad_request, solve_many, unpad_result)
-from .workload import (make_requests, run_mixed_workload,
-                       run_overload_workload, run_scale_workload)
+from .workload import (make_requests, run_continuous_ab,
+                       run_mixed_workload, run_overload_workload,
+                       run_scale_workload)
 
 __all__ = [
     "gesv_batched", "posv_batched", "gels_batched", "last_escalations",
@@ -57,7 +58,7 @@ __all__ = [
     "FlightRecord", "FlightRecorder", "validate_flight",
     "BucketPolicy", "ServeQueue", "Ticket", "pad_request", "unpad_result",
     "solve_many", "make_requests", "run_mixed_workload",
-    "run_overload_workload", "run_scale_workload",
+    "run_overload_workload", "run_scale_workload", "run_continuous_ab",
     "AdmissionController", "AdmissionPolicy", "DEFAULT_LANE",
     "EscalationBudget", "LANES", "TokenBucket", "shed_lanes_from_verdicts",
     "QueueOverloadError", "DeadlineExceededError", "SERVE_SITE",

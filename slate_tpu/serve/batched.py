@@ -223,23 +223,34 @@ class PendingBatch:
     batch k."""
 
     __slots__ = ("routine", "B", "a0", "b", "squeeze", "opts", "out",
-                 "want_verdict")
+                 "want_verdict", "n_real")
 
-    def __init__(self, routine, B, a0, b, squeeze, opts, out, want_verdict):
+    def __init__(self, routine, B, a0, b, squeeze, opts, out, want_verdict,
+                 n_real=None):
         self.routine, self.B = routine, B
         self.a0, self.b, self.squeeze = a0, b, squeeze
         self.opts, self.out, self.want_verdict = opts, out, want_verdict
+        self.n_real = n_real
 
 
 def start_batched(routine: str, A, B, opts=None, cache=None,
-                  donate: bool = False) -> PendingBatch:
+                  donate: bool = False,
+                  n_real: Optional[int] = None) -> PendingBatch:
     """Dispatch half of a batched solve: validate, inject, and enqueue the
     async device call — NO host sync.  Returns a :class:`PendingBatch` for
     :func:`finish_batched`; until then the device computes in the
     background (JAX async dispatch), which is the overlap the executor
     pool's split data path is built on.  The executable-cache lookup
     happens here, on the calling thread (``cache.last_lookup()`` is
-    thread-local — probe it before handing off)."""
+    thread-local — probe it before handing off).
+
+    ``n_real`` is the ghost-slot boundary (continuous batching's slotted
+    variants): elements ``[n_real:]`` are identity-system fill padding the
+    batch up to its compiled slot capacity.  The verdict/escalation half
+    ignores them entirely — they are never health-checked, never ladder
+    re-run, never debit the escalation budget, and get no SolveReport —
+    so a poisoned or overflowed ghost can never masquerade as (or bill
+    like) real traffic.  ``None`` means every element is real."""
     opts = Options.make(opts)
     a0, b, squeeze = _as_batch(A, B, routine)
     a = _inject_each(routine, a0)
@@ -250,7 +261,8 @@ def start_batched(routine: str, A, B, opts=None, cache=None,
     # the zero-sync fast path where nothing is read back after execution
     out = _run_batched(routine, a, b, opts, cache,
                        donate and not want_verdict)
-    return PendingBatch(routine, B, a0, b, squeeze, opts, out, want_verdict)
+    return PendingBatch(routine, B, a0, b, squeeze, opts, out, want_verdict,
+                        n_real=n_real)
 
 
 def finish_batched(pb: PendingBatch):
@@ -264,6 +276,10 @@ def finish_batched(pb: PendingBatch):
     routine, opts = pb.routine, pb.opts
     a0, b, B = pb.a0, pb.b, pb.B
     batch = a0.shape[0]
+    # ghost-slot boundary: only elements [:n_real] are health-checked,
+    # escalated, budgeted, or reported — slot fill is inert by construction
+    n_real = batch if pb.n_real is None else max(min(int(pb.n_real),
+                                                     batch), 0)
     want_verdict = pb.want_verdict
     payload, info = list(pb.out[:-1]), pb.out[-1]
 
@@ -272,13 +288,15 @@ def finish_batched(pb: PendingBatch):
         reports = [SolveReport(routine=routine,
                                precision_used=str(a0.dtype),
                                fallback_chain=("batched",))
-                   for _ in range(batch)]
+                   for _ in range(n_real)]
     forced_bad: set = set()       # failed elements that never escalated —
     #                               their recovered verdict is False even
     #                               when info==0 (non-finite payload)
     if want_verdict:
-        # the batch's single host sync: per-element info + finiteness
-        bad = (np.asarray(info) != 0) | ~_finite_mask(payload[0])
+        # the batch's single host sync: per-element info + finiteness,
+        # ghost slots excluded from the verdict mask
+        bad = ((np.asarray(info)[:n_real] != 0)
+               | ~_finite_mask(payload[0][:n_real]))
         failed = [int(i) for i in np.nonzero(bad)[0]]
         if failed and opts.use_fallback_solver:
             gate = getattr(_tl, "esc_gate", None)
@@ -314,48 +332,53 @@ def finish_batched(pb: PendingBatch):
     return payload, info, reports
 
 
-def _solve_batched(routine: str, A, B, opts, cache, donate):
+def _solve_batched(routine: str, A, B, opts, cache, donate, n_real=None):
     """Shared driver body; returns (payload tuple, info[, reports]).  The
     one-shot composition of the dispatch/resolve halves the executor pool
     runs on separate threads."""
     return finish_batched(start_batched(routine, A, B, opts=opts,
-                                        cache=cache, donate=donate))
+                                        cache=cache, donate=donate,
+                                        n_real=n_real))
 
 
 @instrument
-def gesv_batched(A, B, opts=None, cache=None, donate=False):
+def gesv_batched(A, B, opts=None, cache=None, donate=False, n_real=None):
     """Batched ``gesv``: solve ``A[i] X[i] = B[i]`` for a (batch, n, n) stack.
 
     Returns ``(X, perm, info)`` with ``perm`` (batch, n) and ``info``
     (batch,) int32 per-request codes; with ``Options(solve_report=True)``,
     ``(X, perm, info, reports)`` where ``reports`` is one
     :class:`SolveReport` per element.  See the module docstring for the
-    escalation and fault-injection semantics."""
+    escalation and fault-injection semantics.  ``n_real`` marks the ghost-
+    slot boundary: elements past it are slot fill and stay outside the
+    verdict/escalation/report path (see :func:`start_batched`)."""
     payload, info, reports = _solve_batched("gesv_batched", A, B, opts,
-                                            cache, donate)
+                                            cache, donate, n_real=n_real)
     x, perm = payload
     return (x, perm, info) if reports is None else (x, perm, info, reports)
 
 
 @instrument
-def posv_batched(A, B, opts=None, cache=None, donate=False):
+def posv_batched(A, B, opts=None, cache=None, donate=False, n_real=None):
     """Batched SPD solve: ``A[i] X[i] = B[i]`` with each A[i] the *full*
     Hermitian matrix.  Returns ``(X, info)``; with
-    ``Options(solve_report=True)``, ``(X, info, reports)``."""
+    ``Options(solve_report=True)``, ``(X, info, reports)``.  ``n_real``
+    marks the ghost-slot boundary (see :func:`start_batched`)."""
     payload, info, reports = _solve_batched("posv_batched", A, B, opts,
-                                            cache, donate)
+                                            cache, donate, n_real=n_real)
     return (payload[0], info) if reports is None else \
         (payload[0], info, reports)
 
 
 @instrument
-def gels_batched(A, B, opts=None, cache=None, donate=False):
+def gels_batched(A, B, opts=None, cache=None, donate=False, n_real=None):
     """Batched least squares: min ‖A[i] X[i] − B[i]‖ over a (batch, m, n)
     stack (tall/square = CSNE with Householder escape; wide = LQ min-norm —
     the shape class is static per bucket).  Returns ``(X, info)`` with X
     (batch, n, nrhs); with ``Options(solve_report=True)``,
-    ``(X, info, reports)``."""
+    ``(X, info, reports)``.  ``n_real`` marks the ghost-slot boundary (see
+    :func:`start_batched`)."""
     payload, info, reports = _solve_batched("gels_batched", A, B, opts,
-                                            cache, donate)
+                                            cache, donate, n_real=n_real)
     return (payload[0], info) if reports is None else \
         (payload[0], info, reports)

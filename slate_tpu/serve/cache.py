@@ -171,17 +171,33 @@ class ExecutableCache:
 
     def warmup(self, routine: str, build: Callable,
                shapes: Sequence[Tuple[Tuple[int, ...], Any]],
-               opts: Optional[Options] = None, donate: bool = False) -> None:
-        """Pre-compile one executable without running it.
+               opts: Optional[Options] = None, donate: bool = False,
+               slots: Optional[Sequence[int]] = None) -> int:
+        """Pre-compile executables without running them; returns how many
+        executables are now warm for this call.
 
         ``shapes`` is a sequence of ``(shape, dtype)`` pairs, one per
         argument of ``build`` — the warm-up API the queue calls for every
         (routine, shape bucket, batch bucket) combo it may pack, so the
-        serving path hits 100% after warm-up by construction."""
+        serving path hits 100% after warm-up by construction.
+
+        ``slots`` is the **slot ladder** (continuous batching): a sequence
+        of batch capacities.  Each entry compiles one variant with that
+        capacity prepended as the leading batch axis of every shape in
+        ``shapes`` (which then describe ONE element's bucket shape, no
+        batch axis) — so a staged chunk of any occupancy dispatches into
+        the smallest fitting slot without a fresh compile, ghost slots
+        filling the rest.  ``slots=None`` keeps the single-executable
+        behavior (``shapes`` carry their own batch axis)."""
         import jax
 
-        args = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in shapes]
-        self.get(routine, build, args, opts, donate=donate)
+        ladders = [None] if slots is None else list(slots)
+        for nb in ladders:
+            args = [jax.ShapeDtypeStruct(
+                        tuple(s) if nb is None else (int(nb),) + tuple(s), d)
+                    for s, d in shapes]
+            self.get(routine, build, args, opts, donate=donate)
+        return len(ladders)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -145,13 +145,18 @@ class Ticket:
     ``exhausted``) — the same fields the flight recorder persists.  The
     overload contract adds ``lane`` (priority lane) and ``deadline_s`` /
     ``t_deadline`` (the submitted budget and its absolute ``perf_counter``
-    expiry; None = no deadline).
+    expiry; None = no deadline).  Continuous batching adds ``slot_joined``:
+    the request was appended to an already-staged dispatch instead of
+    waiting for its own flush window (``stages["slot_join"]`` is the
+    submit->join latency; ``queue_wait`` stays the full submit->batch-start
+    wait, so joined vs flushed waits are directly comparable).
     """
 
     __slots__ = ("routine", "shape", "_event", "_value", "_error",
                  "t_submit", "t_submit_unix", "latency_s", "trace_id",
                  "stages", "cache_hit", "ladder", "exhausted",
-                 "lane", "deadline_s", "t_deadline", "executor")
+                 "lane", "deadline_s", "t_deadline", "executor",
+                 "slot_joined")
 
     def __init__(self, routine: str, shape, lane: str = DEFAULT_LANE,
                  deadline: Optional[float] = None):
@@ -173,6 +178,7 @@ class Ticket:
         self.t_deadline = (None if deadline is None
                            else self.t_submit + float(deadline))
         self.executor = ""
+        self.slot_joined = False
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -261,7 +267,8 @@ def _flight_record(it: _Pending, routine: str, bucket_s: str, nb: int,
         stages=dict(tk.stages), info=info, cache_hit=tk.cache_hit,
         batch=nb, occupancy=n_real / max(nb, 1), ladder=tk.ladder,
         exhausted=tk.exhausted, error=error, lane=tk.lane, reason=reason,
-        deadline_s=tk.deadline_s, executor=executor or tk.executor)
+        deadline_s=tk.deadline_s, executor=executor or tk.executor,
+        slot_joined=tk.slot_joined)
 
 
 def _capped_error(routine: str, info: int) -> NumericalError:
@@ -420,6 +427,28 @@ def _fail_batch(items: Sequence[_Pending], routine: str, bucket_s: str,
         flight.on_exhaustion(last_rec, reason=reason)
 
 
+def _record_pad_waste(obs, bucket: Tuple[int, int, int],
+                      items: Sequence[_Pending], nb: int,
+                      labels: Dict[str, str]) -> None:
+    """Dispatch-time padding-waste evidence (the signal ROADMAP 3(a)'s
+    bucket-boundary tuner needs): operand elements carrying no real data —
+    shape pad inside each real slot plus whole ghost slots — as a counter
+    plus a per-batch pad fraction.  Host-side arithmetic only."""
+    bm, bn, br = bucket
+    slot_elems = bm * bn + bm * br
+    real = sum(int(np.asarray(it.a).size) + int(np.asarray(it.b).size)
+               for it in items)
+    waste = nb * slot_elems - real
+    obs.counter("slate_serve_pad_waste_elems_total",
+                "padded operand elements carrying no real data "
+                "(shape pad + ghost slots), counted at dispatch").inc(
+                    waste, **labels)
+    obs.histogram("slate_serve_pad_fraction",
+                  "padded-but-not-real fraction of each dispatched batch",
+                  buckets=_OCCUPANCY_BUCKETS).observe(
+                      waste / max(nb * slot_elems, 1), **labels)
+
+
 def _batch_counters(obs, labels: Dict[str, str], n_items: int, nb: int,
                     t0: float) -> None:
     obs.counter("slate_serve_batches_total",
@@ -476,6 +505,7 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
             raise RuntimeError("chaos: injected worker crash")
     t0 = time.perf_counter()
     nb = policy.round_batch(len(items))
+    _record_pad_waste(obs, bucket, items, nb, labels)
     for it in items:                      # stage: queue wait (per request)
         wait = t0 - it.ticket.t_submit
         it.ticket.stages["queue_wait"] = wait
@@ -499,7 +529,12 @@ def _run_bucket_batch(routine: str, bucket: Tuple[int, int, int],
             # rides as the "driver" label instead)
             with obs.scope("serve.execute_batch", device_sync=True,
                            driver=routine, bucket=bucket_s) as sp:
-                out = DRIVERS[routine](A, B, opts, cache=cache)
+                drv = DRIVERS[routine]
+                # ghost-slot accounting (n_real) is a stock-driver contract;
+                # a monkeypatched driver keeps the pre-continuous signature
+                kw = ({"n_real": len(items)}
+                      if drv is _STOCK_DRIVERS.get(routine) else {})
+                out = drv(A, B, opts, cache=cache, **kw)
                 x, info = out[0], out[-1]
                 sp.set_result(x)
             escal = _batched.last_escalations()
@@ -620,6 +655,24 @@ class Executor:
             self._cv.notify_all()
         self._publish_depth()
 
+    def try_join(self, key: tuple, item: _Pending, join_max: int) -> bool:
+        """Continuous batching: append ``item`` to a staged chunk —
+        queued in ``_work`` but not yet dispatched — whose
+        (routine, bucket, dtype) matches ``key`` and whose occupancy is
+        below ``join_max``.  Lanes may differ (a batch-lane staged chunk
+        absorbs an interactive arrival; the joined ticket keeps its own
+        lane for SLOs and expiry).  Returns False when nothing here is
+        joinable; ``_depth`` counts chunks, so a join changes nothing."""
+        with self._cv:
+            if self.dead is not None or self.closed:
+                return False
+            for chunk in self._work:
+                if (chunk.key[1:] == key[1:]
+                        and len(chunk.items) < join_max):
+                    chunk.items.append(item)
+                    return True
+        return False
+
     def close(self) -> None:
         """Stop accepting work; the dispatcher drains ``_work`` and the
         resolver drains the in-flight queue before the threads exit."""
@@ -728,6 +781,8 @@ class Executor:
         items = chunk.items
         t0 = time.perf_counter()
         nb = self.policy.round_batch(len(items))
+        ex_labels = dict(labels, executor=self.name)
+        _record_pad_waste(obs, bucket, items, nb, ex_labels)
         for it in items:                  # stage: queue wait (per request)
             wait = t0 - it.ticket.t_submit
             it.ticket.stages["queue_wait"] = wait
@@ -753,7 +808,7 @@ class Executor:
                 # hand the pending batch to the resolver thread
                 inf.pending = _batched.start_batched(
                     routine + "_batched", A, B, opts=self.opts,
-                    cache=self.cache)
+                    cache=self.cache, n_real=len(items))
             else:
                 # patched/custom driver (DRIVERS is the override hook):
                 # run it synchronously here — no split available for an
@@ -904,6 +959,7 @@ class ExecutorPool:
                  esc_gate: Optional[Callable[[int], int]] = None,
                  steal_threshold: int = 4,
                  inflight_limit: int = 2,
+                 join_max: Optional[int] = None,
                  on_chunk_done: Optional[Callable[[Chunk], None]] = None,
                  on_item_expired: Optional[
                      Callable[[tuple, _Pending], None]] = None,
@@ -919,6 +975,10 @@ class ExecutorPool:
                              f"got {len(caches)}")
         self.policy = policy
         self.opts = opts
+        #: continuous batching: when set (the policy's max_batch), staged
+        #: chunks are joinable — submit-time arrivals via :meth:`try_join`,
+        #: scheduler pops merged into a staged same-key chunk at dispatch
+        self.join_max = None if join_max is None else max(int(join_max), 1)
         self.steal_threshold = max(int(steal_threshold), 1)
         #: per-executor work acceptance bound: deep enough for imbalance to
         #: trigger steals, shallow enough that lane priority is re-decided
@@ -989,6 +1049,15 @@ class ExecutorPool:
     def size(self) -> int:
         return len(self.executors)
 
+    def has_starved(self) -> bool:
+        """Whether some live executor is fully idle (nothing staged,
+        nothing in flight) — continuous batching's eager-flush gate: while
+        an executor starves, any occupancy is worth dispatching NOW; once
+        the whole pool is busy, eager flushing would only shred buckets
+        into ghost-padded slivers that a staged join must then repair."""
+        return any(ex.depth() == 0 for ex in self.executors
+                   if ex.dead is None and not ex.closed)
+
     def can_accept(self) -> bool:
         """Whether some live executor has room — the scheduler's gate for
         popping the next chunk (keeps executor deques shallow so lane
@@ -1004,10 +1073,54 @@ class ExecutorPool:
             ex.join(max(deadline - time.monotonic(), 0.0))
 
     # -- routing -------------------------------------------------------------
+    def try_join(self, key: tuple, item: _Pending) -> Optional[Executor]:
+        """Continuous batching's submit path: offer ``item`` to every live
+        executor's staged (queued-not-dispatched) chunks; the first with a
+        matching (routine, bucket, dtype) chunk below ``join_max`` takes
+        it.  Returns the joining executor, or None when no staged slot is
+        open (the caller falls back to the pending queue)."""
+        if self.join_max is None:
+            return None
+        for ex in self.executors:
+            if ex.dead is None and not ex.closed \
+                    and ex.try_join(key, item, self.join_max):
+                return ex
+        return None
+
+    def _merge_staged(self, chunk: Chunk) -> Optional[Executor]:
+        """Continuous batching's scheduler path: fold a freshly popped
+        chunk into a staged same-(routine, bucket, dtype) chunk with room
+        for ALL its items — one bigger dispatch instead of two small ones
+        (no new chunk, no depth change).  Partial merges are deliberately
+        not attempted: splitting a chunk would split its completion
+        accounting."""
+        for ex in self.executors:
+            if ex.dead is not None or ex.closed:
+                continue
+            with ex._cv:
+                if ex.dead is not None or ex.closed:
+                    continue
+                for staged in ex._work:
+                    if (staged.key[1:] == chunk.key[1:]
+                            and len(staged.items) + len(chunk.items)
+                            <= self.join_max):
+                        staged.items.extend(chunk.items)
+                        _obs().counter(
+                            "slate_serve_staged_merges_total",
+                            "popped chunks folded into a staged same-key "
+                            "dispatch (continuous batching)").inc(
+                                routine=chunk.routine, executor=ex.name)
+                        return ex
+        return None
+
     def dispatch(self, chunk: Chunk) -> Executor:
-        """Route one chunk: residency first, least-loaded fallback,
-        steal past the threshold.  Raises :class:`SlateError` when no
-        executor is live."""
+        """Route one chunk: staged-merge first (continuous mode), then
+        residency, least-loaded fallback, steal past the threshold.
+        Raises :class:`SlateError` when no executor is live."""
+        if self.join_max is not None:
+            ex = self._merge_staged(chunk)
+            if ex is not None:
+                return ex
         ex = self._route(chunk)
         if ex is None:
             raise SlateError("serve: no live executors")

@@ -71,6 +71,10 @@ class FlightRecord:
     reason: Optional[str] = None
     deadline_s: Optional[float] = None       # submitted deadline budget
     executor: str = ""                       # serving executor (ex0, ex1, …)
+    #: continuous batching: the request joined an already-staged dispatch
+    #: (its queue_wait never paid a flush window — ``stages["slot_join"]``
+    #: is submit->join, ``queue_wait`` the full submit->batch-start wait)
+    slot_joined: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -232,6 +232,18 @@ class ServeQueue:
     :class:`QueueOverloadError`, expired tickets resolve with
     :class:`DeadlineExceededError`.  ``close()`` drains and stops the
     scheduler + pool; the queue is also a context manager.
+
+    ``continuous=True`` switches flush discipline to rolling admission
+    (continuous batching, ROADMAP 2(a)): non-empty buckets dispatch
+    eagerly instead of waiting out ``max_wait_ms``, and late arrivals to a
+    hot bucket *join* the next staged dispatch — at submit time via the
+    pool's :meth:`~slate_tpu.serve.executor.ExecutorPool.try_join`, and at
+    pop time by folding a popped chunk into a staged same-key chunk.  The
+    slot ladder (``policy.batch_dims`` + identity-ghost fill) means any
+    occupancy runs without a fresh compile, so eager dispatch costs no
+    compiles, only pad slots — which the pad-waste metrics make visible.
+    Per-element results are bit-identical to flush mode at equal slot
+    capacity (same compiled program, ghost slots inert).
     """
 
     def __init__(self, policy: Optional[BucketPolicy] = None,
@@ -241,7 +253,8 @@ class ServeQueue:
                  flight: Optional[FlightRecorder] = None,
                  admission: Optional[object] = None,
                  executors: int = 1,
-                 steal_threshold: int = 4):
+                 steal_threshold: int = 4,
+                 continuous: bool = False):
         self.policy = policy or BucketPolicy()
         self.opts = Options.make(opts)
         self.cache = default_cache() if cache is None else cache
@@ -253,6 +266,7 @@ class ServeQueue:
         if int(executors) < 1:
             raise SlateError(f"serve: executors must be >= 1, "
                              f"got {executors}")
+        self.continuous = bool(continuous)
         self._slo_monitor = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -278,6 +292,7 @@ class ServeQueue:
             flight=self.flight,
             esc_gate=self.admission.escalations.take,
             steal_threshold=steal_threshold,
+            join_max=self.policy.max_batch if self.continuous else None,
             on_chunk_done=self._chunk_done,
             on_item_expired=self._expire_inflight,
             on_executor_death=self._on_executor_death,
@@ -325,21 +340,67 @@ class ServeQueue:
             except QueueOverloadError as e:
                 overload = e
             else:
-                fk = (lane,) + key
-                self._pending.setdefault(fk, []).append(item)
-                self._depths[lane] = depth + 1
-                self._depth_gauge(lane)
-                self._oldest.setdefault(fk, time.perf_counter())
-                td = item.ticket.t_deadline
-                if td is not None:
-                    cur = self._min_deadline.get(fk)
-                    if cur is None or td < cur:
-                        self._min_deadline[fk] = td
-                self._cv.notify()
+                if self.continuous:
+                    # rolling admission: pre-count the request in-flight
+                    # BEFORE offering it to a staged chunk — the staged
+                    # chunk's chunk_done decrements per item, and counting
+                    # after a successful join could race that decrement
+                    # (flush() would then wait on a phantom forever)
+                    self._inflight += 1
+                else:
+                    self._enqueue_locked(lane, key, item)
         if overload is not None:
             self._record_shed(item, key, overload)
             raise overload
+        if self.continuous:
+            ex = self.pool.try_join((lane,) + key, item)
+            if ex is not None:
+                self._note_slot_join(key, item, ex)
+            else:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+                    try:
+                        # the queue may have died between admit and here —
+                        # inserting now would strand a ticket forever
+                        self._check_alive()
+                    except SlateError as e:
+                        item.ticket._resolve(error=e)
+                        raise
+                    self._enqueue_locked(lane, key, item)
         return item.ticket
+
+    def _enqueue_locked(self, lane: str, key: tuple,
+                        item: _Pending) -> None:
+        """Insert one admitted request into ``_pending`` and sync the
+        per-key maps + lane depth (caller holds the lock)."""
+        fk = (lane,) + key
+        self._pending.setdefault(fk, []).append(item)
+        self._depths[lane] = self._depths.get(lane, 0) + 1
+        self._depth_gauge(lane)
+        self._oldest.setdefault(fk, time.perf_counter())
+        td = item.ticket.t_deadline
+        if td is not None:
+            cur = self._min_deadline.get(fk)
+            if cur is None or td < cur:
+                self._min_deadline[fk] = td
+        self._cv.notify()
+
+    def _note_slot_join(self, key: tuple, item: _Pending, ex) -> None:
+        """One submit joined a staged dispatch: stamp the ticket (the
+        flight record + chrome-trace attribution) and count it."""
+        tk = item.ticket
+        tk.slot_joined = True
+        tk.stages["slot_join"] = time.perf_counter() - tk.t_submit
+        routine, bucket, _ = key
+        bucket_s = "x".join(str(d) for d in bucket)
+        _obs().counter("slate_serve_slot_joins_total",
+                       "requests that joined an already-staged dispatch "
+                       "(continuous batching)").inc(
+                           routine=routine, bucket=bucket_s,
+                           executor=ex.name)
+        trace.trace_event("slot_join", routine=routine, bucket=bucket_s,
+                          executor=ex.name, trace_id=tk.trace_id)
 
     def _check_alive(self) -> None:
         """Raise (don't enqueue a ticket that can never resolve) when the
@@ -394,20 +455,21 @@ class ServeQueue:
         # (routine, bucket, batch-rung) is one compile
         buckets = sorted({(routine, self.policy.bucket(routine, m, n, nrhs))
                           for routine, m, n, nrhs in combos})
+        slots = [nb for nb in self.policy.batch_dims
+                 if nb <= self.policy.max_batch]
         seen = 0
         for routine, (bm, bn, br) in buckets:
-            for nb in self.policy.batch_dims:
-                if nb > self.policy.max_batch:
-                    continue
-                # the drivers' own builder: a local copy could drift and the
-                # cache key would not notice (it excludes function identity)
-                for cache in self.pool.caches():
-                    cache.warmup(
-                        routine + "_batched",
-                        _batched.batched_build(routine + "_batched"),
-                        [((nb, bm, bn), dtype), ((nb, bm, br), dtype)],
-                        self.opts)
-                seen += 1
+            # the drivers' own builder: a local copy could drift and the
+            # cache key would not notice (it excludes function identity);
+            # the slot ladder rides the cache's own warmup API — one
+            # executable per (routine, bucket, slot) per cache
+            for cache in self.pool.caches():
+                cache.warmup(
+                    routine + "_batched",
+                    _batched.batched_build(routine + "_batched"),
+                    [((bm, bn), dtype), ((bm, br), dtype)],
+                    self.opts, slots=slots)
+            seen += len(slots)
         return seen
 
     # -- scheduler -----------------------------------------------------------
@@ -433,6 +495,21 @@ class ServeQueue:
                 self._oldest.get(key, float("inf")))
 
     def _ready_keys(self, now: float) -> List[tuple]:
+        if self.continuous and self.pool.has_starved():
+            # continuous batching: while some executor STARVES (idle, no
+            # staged or in-flight chunk), every non-empty bucket is ready
+            # NOW — the fixed-wait tax is gone and any occupancy is
+            # compile-free on the slot ladder.  Once the whole pool is
+            # busy, fall through to the flush rules below: eager flushing
+            # a saturated pool only shreds buckets into ghost-padded
+            # slivers (throughput loss with no latency win — queueing
+            # dominates), while held buckets keep filling and late
+            # arrivals still join the chunks already staged.  Deadline
+            # sweeps and pool backpressure (can_accept) apply unchanged.
+            ready = [k for k, v in self._pending.items() if v]
+            ready.sort(key=self._key_order)
+            self._early_ready = set()
+            return ready
         ready = []
         early = set()
         for key, items in self._pending.items():
@@ -664,7 +741,11 @@ class ServeQueue:
         """Resolve one past-deadline ticket with its typed error — before
         it wastes a batch slot — and leave the evidence trail."""
         tk = it.ticket
-        lane, routine, bucket, _ = key
+        _, routine, bucket, _ = key
+        # the ticket's own lane, not the chunk key's: a continuous-mode
+        # join puts (say) an interactive item inside a batch-lane chunk,
+        # and its expiry must be attributed to ITS lane
+        lane = tk.lane
         bucket_s = "x".join(str(d) for d in bucket)
         elapsed = time.perf_counter() - tk.t_submit
         err = DeadlineExceededError(lane=lane, deadline_s=tk.deadline_s or 0.0,

@@ -31,7 +31,7 @@ import numpy as np
 from ..core.exceptions import (DeadlineExceededError, NumericalError,
                                QueueOverloadError, SlateError)
 from ..core.types import Options
-from .admission import AdmissionPolicy, LANES
+from .admission import AdmissionPolicy, DEFAULT_LANE, LANES
 from .cache import ExecutableCache
 from .flight import FlightRecorder
 from .queue import BucketPolicy, ServeQueue, solve_many
@@ -102,7 +102,10 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                        return_tickets: bool = False,
                        executors: int = 1,
                        after_warmup: Optional[Callable[[ServeQueue], None]]
-                       = None) -> Dict[str, Any]:
+                       = None,
+                       continuous: bool = False,
+                       pace_rate: Optional[float] = None,
+                       lane: str = DEFAULT_LANE) -> Dict[str, Any]:
     """Generate, warm up, and serve a mixed workload; return the stats dict.
 
     Two passes over the same request stream: the warm-up pass compiles every
@@ -122,7 +125,16 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
 
     ``executors=N`` serves through an N-executor pool (the serve_scale
     bench axis); cache stats and the zero-miss-after-warmup gate aggregate
-    across every executor's cache."""
+    across every executor's cache.
+
+    The continuous-batching A/B axis: ``continuous=True`` runs the queue
+    with rolling admission (eager dispatch + slot joins); ``pace_rate``
+    (requests/sec) replaces the closed-loop submit burst with seeded
+    exponential inter-arrivals — the open-loop shape where queue_wait
+    differences between the two flush disciplines are visible; ``lane``
+    submits every request on that priority lane.  The stats then carry
+    ``queue_wait_p50_ms``/``queue_wait_p99_ms`` (submit -> batch start)
+    and ``slot_joins``/``slot_join_rate``."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = ExecutableCache()
@@ -131,7 +143,8 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
                      for r, a, b in reqs})
 
     q = ServeQueue(policy=policy, opts=opts, cache=cache, start=use_queue,
-                   flight=flight, executors=executors)
+                   flight=flight, executors=executors,
+                   continuous=continuous)
     warm_stats = None
     if warm:
         t0 = time.perf_counter()
@@ -147,7 +160,22 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
     latencies: List[float] = []
     tickets: List[Any] = []
     if use_queue:
-        tickets = [q.submit(r, a, b) for r, a, b in reqs]
+        if pace_rate:
+            # open-loop arrivals: seeded exponential gaps at the target
+            # rate — closed-loop bursts hide flush-window waits because
+            # every bucket fills instantly
+            gap_rng = np.random.default_rng(seed + 1)
+            gaps = gap_rng.exponential(1.0 / float(pace_rate),
+                                       size=len(reqs))
+            t_next = time.perf_counter()
+            for (r, a, b), gap in zip(reqs, gaps):
+                pause = t_next - time.perf_counter()
+                if pause > 0:
+                    time.sleep(pause)
+                tickets.append(q.submit(r, a, b, lane=lane))
+                t_next += gap
+        else:
+            tickets = [q.submit(r, a, b, lane=lane) for r, a, b in reqs]
         results = [t.result(timeout=300.0) for t in tickets]
         latencies = [t.latency_s for t in tickets]
     else:
@@ -183,7 +211,18 @@ def run_mixed_workload(num_requests: int = 1000, seed: int = 0,
         "misses_after_warmup": pool1["misses"] - miss0,
         "hits_measured": pool1["hits"] - hit0,
         "warmup": warm_stats,
+        "continuous": bool(continuous),
+        "pace_rate": None if not pace_rate else round(float(pace_rate), 1),
     }
+    if tickets:
+        qw = [t.stages.get("queue_wait") for t in tickets]
+        qw = [w for w in qw if w is not None]
+        if qw:
+            stats["queue_wait_p50_ms"] = round(_percentile_ms(qw, 50), 3)
+            stats["queue_wait_p99_ms"] = round(_percentile_ms(qw, 99), 3)
+        joins = sum(1 for t in tickets if t.slot_joined)
+        stats["slot_joins"] = joins
+        stats["slot_join_rate"] = round(joins / max(len(tickets), 1), 4)
     if latencies:
         stats["p50_ms"] = round(_percentile_ms(latencies, 50), 3)
         stats["p99_ms"] = round(_percentile_ms(latencies, 99), 3)
@@ -243,7 +282,8 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
                           after_warmup: Optional[Callable[[ServeQueue], None]]
                           = None,
                           drain_timeout_s: float = 60.0,
-                          executors: int = 1) -> Dict[str, Any]:
+                          executors: int = 1,
+                          continuous: bool = False) -> Dict[str, Any]:
     """Drive the serving queue past its measured capacity; return the tally.
 
     Three phases: (1) warm up every executable and *measure* capacity with
@@ -266,7 +306,11 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
     (and the offered rate sized from it) scales by N, and the arrival loop
     RE-calibrates mid-run when the pool shrinks — a chaos-killed executor
     drops :meth:`ServeQueue.capacity_fraction`, the offered rate follows,
-    and ``recalibrations`` counts the adjustments."""
+    and ``recalibrations`` counts the adjustments.
+
+    ``continuous=True`` runs the same soak under rolling admission — the
+    overload contract (typed shedding, zero hung, deadline expiry) must
+    hold regardless of flush discipline."""
     policy = policy or BucketPolicy()
     opts = Options.make(opts)
     cache = ExecutableCache()
@@ -287,7 +331,8 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
 
     admission = admission or default_overload_admission(capacity)
     q = ServeQueue(policy=policy, opts=opts, cache=cache, flight=flight,
-                   admission=admission, executors=executors)
+                   admission=admission, executors=executors,
+                   continuous=continuous)
     if int(executors) > 1:
         # the extra executors' caches are cold — warm them too, before the
         # measured window opens (executor 0 re-warms as pure hits)
@@ -385,6 +430,7 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
     stats: Dict[str, Any] = {
         "capacity_solves_per_sec": round(capacity, 1),
         "executors": int(executors),
+        "continuous": bool(continuous),
         "capacity_fraction_final": round(q.capacity_fraction(), 3),
         "recalibrations": recalibrations,
         "target_rate": round(target_rate, 1),
@@ -407,6 +453,105 @@ def run_overload_workload(duration_s: float = 15.0, seed: int = 0,
         stats[f"{lane}_p50_ms"] = round(_percentile_ms(lats, 50), 3)
         stats[f"{lane}_p99_ms"] = round(_percentile_ms(lats, 99), 3)
     return stats
+
+
+def run_continuous_ab(num_requests: int = 300, seed: int = 0,
+                      policy: Optional[BucketPolicy] = None,
+                      opts: Optional[Options] = None,
+                      dims: Sequence[int] = (8, 13, 24),
+                      routines: Sequence[str] = DEFAULT_ROUTINES,
+                      rounds: int = 2, executors: int = 2,
+                      pace_factor: float = 0.2,
+                      discard_rounds: int = 1) -> Dict[str, Any]:
+    """Interleaved continuous-vs-flush A/B — the ROADMAP 2(a) acceptance
+    measurement.
+
+    Two phases, each alternating flush / continuous runs back-to-back
+    (interleaving absorbs machine drift — neither mode gets the warm or
+    the noisy half of the wall clock):
+
+    1. **closed-loop** rounds (submit bursts): warm throughput per mode
+       (best across rounds, see below), and ``warm_ratio`` = continuous /
+       flush — the "within 0.9x" gate.
+    2. **paced** rounds at ``pace_factor`` x the flush mode's measured
+       closed-loop throughput, every request on the interactive lane:
+       open-loop arrivals are where the flush window's fixed-wait tax is
+       visible, so ``queue_wait_p50_ms`` per mode is the headline number
+       (continuous must come in below flush), with the continuous mode's
+       ``slot_join_rate`` alongside.  ``pace_factor`` deliberately sits
+       well below saturation: the fixed-wait tax is the dominant latency
+       term only while buckets go out underfilled (per-bucket
+       inter-arrival above ``max_wait_ms``); near saturation queueing
+       dominates BOTH modes and the comparison drowns in service-time
+       noise.
+
+    The first ``discard_rounds`` interleaved pairs are run and THROWN
+    AWAY: the first serving runs in a fresh process are dominated by
+    process-level warm-in (XLA compile state, host thread pools) that
+    dwarfs any scheduler difference — measured on CPU, the same run
+    config speeds up ~5x between the first and third pair, then holds
+    steady.  Only the post-transient rounds are recorded.
+    """
+    mode_kw = (("flush", False), ("continuous", True))
+    for _ in range(max(int(discard_rounds), 0)):
+        for m, cont in mode_kw:
+            run_mixed_workload(num_requests=num_requests, seed=seed,
+                               policy=policy, opts=opts, dims=dims,
+                               routines=routines, executors=executors,
+                               continuous=cont)
+    closed: Dict[str, List[Dict[str, Any]]] = {m: [] for m, _ in mode_kw}
+    for _ in range(max(int(rounds), 1)):
+        for m, cont in mode_kw:
+            s = run_mixed_workload(
+                num_requests=num_requests, seed=seed, policy=policy,
+                opts=opts, dims=dims, routines=routines,
+                executors=executors, continuous=cont)
+            closed[m].append(s)
+    # per-mode BEST rate across rounds: co-tenant noise on a shared host is
+    # one-sided (a stall can only slow a run, nothing makes one faster than
+    # the machine allows), so the max is the low-variance estimator of each
+    # scheduler's sustainable rate — medians of second-long runs still swung
+    # 2x run-to-run under the same config
+    warm = {m: float(max(s["solves_per_sec"] for s in v))
+            for m, v in closed.items()}
+    rate = max(pace_factor * warm["flush"], 1.0)
+    paced: Dict[str, List[Dict[str, Any]]] = {m: [] for m, _ in mode_kw}
+    for _ in range(max(int(rounds), 1)):
+        for m, cont in mode_kw:
+            s = run_mixed_workload(
+                num_requests=num_requests, seed=seed, policy=policy,
+                opts=opts, dims=dims, routines=routines,
+                executors=executors, continuous=cont,
+                pace_rate=rate, lane="interactive")
+            paced[m].append(s)
+
+    def _med(mode: str, key: str) -> Optional[float]:
+        vals = [s[key] for s in paced[mode] if s.get(key) is not None]
+        return round(float(np.median(vals)), 3) if vals else None
+
+    return {
+        "rounds": int(rounds), "executors": int(executors),
+        "requests_per_run": int(num_requests),
+        "offered_rate": round(rate, 1),
+        "warm_solves_per_sec": {m: round(v, 1) for m, v in warm.items()},
+        "warm_solves_per_sec_rounds": {
+            m: [round(s["solves_per_sec"], 1) for s in v]
+            for m, v in closed.items()},
+        "warm_ratio": round(warm["continuous"]
+                            / max(warm["flush"], 1e-9), 3),
+        "queue_wait_p50_ms": {m: _med(m, "queue_wait_p50_ms")
+                              for m, _ in mode_kw},
+        "queue_wait_p99_ms": {m: _med(m, "queue_wait_p99_ms")
+                              for m, _ in mode_kw},
+        "latency_p50_ms": {m: _med(m, "p50_ms") for m, _ in mode_kw},
+        # joins need pressure: the paced (open-loop) rate is the headline
+        # companion to queue_wait, the closed-loop rate shows how hard the
+        # staging slots work when buckets stay hot
+        "slot_join_rate": round(float(np.mean(
+            [s["slot_join_rate"] for s in paced["continuous"]])), 4),
+        "slot_join_rate_closed_loop": round(float(np.mean(
+            [s["slot_join_rate"] for s in closed["continuous"]])), 4),
+    }
 
 
 def run_scale_workload(executor_counts: Sequence[int] = (1, 2, 4),

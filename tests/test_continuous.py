@@ -1,0 +1,333 @@
+"""Continuous batching (rolling admission into in-flight slotted batches):
+the submit-time slot-join path and its ticket/counter evidence, ghost-slot
+inertness at the batched-driver boundary (``n_real``), flush-vs-continuous
+bit-identity at equal slot capacity, deadline expiry of staged-but-never-
+dispatched work, and the PR-7 overload / worker-death contracts re-run with
+``continuous=True``."""
+
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu import obs, robust, serve
+from slate_tpu.core.exceptions import (DeadlineExceededError,
+                                       QueueOverloadError, SlateError)
+from slate_tpu.serve.admission import AdmissionPolicy
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.executor import SERVE_SITE
+from slate_tpu.serve.queue import BucketPolicy, ServeQueue
+
+
+def _dd(n, seed=0):
+    a = np.random.default_rng(seed).standard_normal((n, n)).astype(np.float32)
+    return a + n * np.eye(n, dtype=np.float32)
+
+
+def _rhs(n, nrhs=1, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, nrhs)).astype(np.float32)
+
+
+def _spd(n, seed=0):
+    g = _dd(n, seed)
+    return (g @ g.T + n * np.eye(n, dtype=np.float32)).astype(np.float32)
+
+
+def _singular(n, seed=0, k=3):
+    a = _dd(n, seed)
+    a[:, k] = 0.0
+    a[k, :] = 0.0
+    return a
+
+
+def _queue(executors, *, max_batch=4, batch_dims=(1, 4), max_wait_ms=500.0,
+           **kw):
+    policy = BucketPolicy(max_batch=max_batch, batch_dims=tuple(batch_dims),
+                          max_wait_ms=max_wait_ms)
+    return ServeQueue(policy=policy, cache=ExecutableCache(),
+                      executors=executors, continuous=True, **kw)
+
+
+def _counter_total(name):
+    c = obs.REGISTRY.get(name)
+    return sum(c.series().values()) if c is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# the slot-join path
+
+
+class TestSlotJoin:
+    def test_submit_joins_staged_chunk(self):
+        """While the single executor's dispatcher stalls on its first
+        chunk, the next flush stages a chunk in its work queue; a
+        subsequent submit must JOIN that staged chunk instead of waiting
+        for the next flush — the ticket carries the evidence."""
+        before = _counter_total("slate_serve_slot_joins_total")
+        with robust.FaultPlan([robust.FaultSpec(
+                SERVE_SITE, "slow_executor", call_index=0, delay_s=0.4,
+                executor=0)]):
+            q = _queue(1, max_wait_ms=0.0)
+            try:
+                t1 = q.submit("gesv", _dd(8, 1), _rhs(8))
+                time.sleep(0.1)          # t1 dispatched (compiling+stalled)
+                t2 = q.submit("gesv", _dd(8, 2), _rhs(8))
+                time.sleep(0.1)          # t2's chunk flushed -> staged
+                t3 = q.submit("gesv", _dd(8, 3), _rhs(8))
+                for t in (t1, t2, t3):
+                    assert t.result(timeout=120.0)[1] == 0
+            finally:
+                q.close()
+        assert t3.slot_joined is True
+        assert t3.stages["slot_join"] >= 0.0
+        # the join window closed before these two submitted
+        assert t1.slot_joined is False and t2.slot_joined is False
+        # the joined pair ran as ONE dispatch on the same executor
+        assert t2.executor == t3.executor
+        assert _counter_total("slate_serve_slot_joins_total") - before >= 1.0
+        c = obs.REGISTRY.get("slate_serve_slot_joins_total")
+        assert any(dict(k).get("routine") == "gesv" for k in c.series())
+
+    def test_flush_mode_never_stamps_slot_join(self):
+        policy = BucketPolicy(max_batch=4, batch_dims=(1, 4),
+                              max_wait_ms=2.0)
+        q = ServeQueue(policy=policy, cache=ExecutableCache(), executors=1)
+        try:
+            ts = [q.submit("gesv", _dd(8, s), _rhs(8)) for s in range(4)]
+            for t in ts:
+                assert t.result(timeout=120.0)[1] == 0
+            assert all(t.slot_joined is False for t in ts)
+            assert all("slot_join" not in t.stages for t in ts)
+        finally:
+            q.close()
+
+
+# ---------------------------------------------------------------------------
+# ghost slots at the driver boundary
+
+
+class TestGhostSlotsInert:
+    def test_poisoned_element_fails_alone_ghosts_never_debit_budget(self):
+        """``n_real`` marks the ghost boundary: with slots [2:] filled by
+        OUTRIGHT SINGULAR garbage (all-zero systems, as hostile as fill
+        can get), a zero escalation budget caps exactly ONE element — the
+        real singular request — and the report list covers only the real
+        prefix."""
+        before = _counter_total("slate_serve_escalations_capped_total")
+        z = np.zeros((8, 8), dtype=np.float32)
+        a = np.stack([_dd(8, 1), _singular(8), z, z])
+        b = np.stack([_rhs(8), _rhs(8), np.zeros((8, 1), dtype=np.float32),
+                      np.zeros((8, 1), dtype=np.float32)])
+        prev = serve.set_escalation_gate(lambda n: 0)
+        try:
+            x, perm, info, reports = serve.gesv_batched(
+                a, b, opts={"solve_report": True,
+                            "use_fallback_solver": True}, n_real=2)
+        finally:
+            serve.set_escalation_gate(prev)
+        info = np.asarray(info)
+        assert int(info[0]) == 0
+        assert int(info[1]) != 0          # the poisoned REAL element
+        assert len(reports) == 2          # ghosts get no SolveReport
+        assert reports[0].recovered is True
+        assert reports[1].recovered is False
+        # exactly one capped element: the ghost slots (which would fail
+        # the verdict if consulted) never reached the budget
+        assert _counter_total(
+            "slate_serve_escalations_capped_total") - before == 1.0
+        assert set(serve.last_escalations()) == {1}
+
+    def test_ghosts_never_escalate_under_default_budget(self):
+        """With budget to spare, only the real singular element re-runs
+        the ladder — ghost fill is outside the escalation path
+        entirely."""
+        z = np.zeros((8, 8), dtype=np.float32)
+        a = np.stack([_singular(8), _dd(8, 2), z, z])
+        b = np.stack([_rhs(8)] * 4)
+        x, info = serve.posv_batched(
+            np.stack([_spd(8, 1), _spd(8, 2), z, z]), b,
+            opts={"use_fallback_solver": True}, n_real=2)
+        assert not serve.last_escalations()       # both real elements clean
+        x, perm, info = serve.gesv_batched(
+            a, b, opts={"use_fallback_solver": True}, n_real=2)
+        assert set(serve.last_escalations()) == {0}
+        assert int(np.asarray(info)[1]) == 0
+
+    def test_joined_poisoned_element_fails_alone_e2e(self):
+        """End-to-end with ``continuous=True`` and a zero budget: the
+        singular request resolves with its typed error, its batch sibling
+        is untouched, and the round-up ghost slots replicate neither the
+        failure nor the budget debit."""
+        from slate_tpu.core.exceptions import NumericalError
+
+        before = _counter_total("slate_serve_escalations_capped_total")
+        q = _queue(1, admission=AdmissionPolicy(
+            max_escalations_per_window=0))
+        try:
+            t_ok = q.submit("gesv", _dd(8, 5), _rhs(8))
+            t_bad = q.submit("gesv", _singular(8), _rhs(8))
+            with pytest.raises(NumericalError):
+                t_bad.result(timeout=60.0)
+            assert t_ok.result(timeout=60.0)[1] == 0
+        finally:
+            q.close()
+        assert _counter_total(
+            "slate_serve_escalations_capped_total") - before == 1.0
+
+
+# ---------------------------------------------------------------------------
+# bit-identity at equal slot capacity
+
+
+class TestContinuousBitIdentity:
+    def _serve_groups(self, continuous, groups):
+        policy = BucketPolicy(max_batch=4, batch_dims=(4,),
+                              max_wait_ms=500.0)
+        q = ServeQueue(policy=policy, cache=ExecutableCache(), executors=2,
+                       continuous=continuous)
+        out = []
+        try:
+            for g in groups:
+                ts = [q.submit(r, a, b) for r, a, b in g]
+                out.append([t.result(timeout=120.0) for t in ts])
+        finally:
+            q.close()
+        return out
+
+    @pytest.mark.parametrize("routine", ["gesv", "posv", "gels"])
+    def test_continuous_bit_identical_to_flush(self, routine):
+        """A single-rung batch ladder pins the compiled nb regardless of
+        occupancy, so flush and continuous modes run the SAME executable
+        on the SAME packed operands — per-element results must be
+        bytewise identical (XLA CPU's vmapped cores are reproducible per
+        element only at equal batch rounding)."""
+        rng = np.random.default_rng(11)
+        groups = []
+        for _ in range(2):
+            reqs = []
+            for _ in range(4):
+                n = 8
+                if routine == "gels":
+                    a = rng.standard_normal((2 * n, n)).astype(np.float32)
+                elif routine == "posv":
+                    g = rng.standard_normal((n, n)).astype(np.float32)
+                    a = (g @ g.T + n * np.eye(n)).astype(np.float32)
+                else:
+                    a = rng.standard_normal((n, n)).astype(np.float32) \
+                        + n * np.eye(n, dtype=np.float32)
+                b = rng.standard_normal(
+                    (a.shape[0], 1)).astype(np.float32)
+                reqs.append((routine, a, b))
+            groups.append(reqs)
+        ref = self._serve_groups(False, groups)
+        got = self._serve_groups(True, groups)
+        for gr, gg in zip(ref, got):
+            for (xr, ir), (xg, ig) in zip(gr, gg):
+                assert int(ir) == 0 and int(ig) == 0
+                assert np.asarray(xr).tobytes() == np.asarray(xg).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# deadlines on staged work
+
+
+class TestStagedDeadlines:
+    def test_joined_item_expires_at_dispatch_sweep(self):
+        """A request that slot-joined a STAGED chunk never sits in the
+        pending queue, so the queue's expiry loop cannot see it — the
+        executor's dispatch-time deadline sweep must expire it with the
+        same typed error while its chunk-mates solve normally."""
+        with robust.FaultPlan([robust.FaultSpec(
+                SERVE_SITE, "slow_executor", call_index=0, delay_s=0.6,
+                executor=0)]):
+            q = _queue(1, max_wait_ms=0.0)
+            try:
+                t1 = q.submit("gesv", _dd(8, 1), _rhs(8))
+                time.sleep(0.1)          # t1 dispatched and stalled
+                t2 = q.submit("gesv", _dd(8, 2), _rhs(8))
+                time.sleep(0.1)          # t2 staged behind the stall
+                tb = q.submit("gesv", _dd(8, 3), _rhs(8),
+                              lane="best_effort", deadline=0.1)
+                assert tb.slot_joined is True
+                with pytest.raises(DeadlineExceededError):
+                    tb.result(timeout=60.0)
+                assert t1.result(timeout=60.0)[1] == 0
+                assert t2.result(timeout=60.0)[1] == 0
+            finally:
+                q.close()
+        c = obs.REGISTRY.get("slate_serve_deadline_expired_total")
+        assert c is not None and sum(c.series().values()) >= 1
+
+    def test_pending_deadline_expiry_unchanged_continuous(self):
+        """The queue-side expiry path (requests still in pending) keeps
+        working under continuous mode."""
+        specs = [robust.FaultSpec(SERVE_SITE, "slow_executor",
+                                  delay_s=0.4, executor=e) for e in (0, 1)]
+        with robust.FaultPlan(specs):
+            q = _queue(2, max_wait_ms=2.0)
+            try:
+                t1 = q.submit("gesv", _dd(8), _rhs(8), lane="interactive")
+                t2 = q.submit("posv", _spd(8, 2), _rhs(8),
+                              lane="interactive")
+                time.sleep(0.05)
+                tb = q.submit("gesv", _dd(8, 5), _rhs(8),
+                              lane="best_effort", deadline=0.05)
+                with pytest.raises(DeadlineExceededError):
+                    tb.result(timeout=30.0)
+                assert t1.result(timeout=30.0)[1] == 0
+                assert t2.result(timeout=30.0)[1] == 0
+            finally:
+                q.close()
+
+
+# ---------------------------------------------------------------------------
+# the overload and worker-death contracts, continuous=True
+
+
+class TestContinuousOverloadAndDeath:
+    def test_depth_shed_typed_error_continuous(self):
+        q = ServeQueue(policy=BucketPolicy(),
+                       admission=AdmissionPolicy(
+                           max_depth={"best_effort": 1}),
+                       cache=ExecutableCache(), start=False,
+                       continuous=True)
+        try:
+            q.submit("gesv", _dd(8, 1), _rhs(8), lane="best_effort")
+            with pytest.raises(QueueOverloadError) as ei:
+                q.submit("gesv", _dd(8, 2), _rhs(8), lane="best_effort")
+            assert ei.value.lane == "best_effort"
+            assert ei.value.reason == "depth"
+        finally:
+            q.close()
+
+    def test_one_death_reroutes_and_pool_survives_continuous(self):
+        """PR-6's death contract holds under rolling admission: the dying
+        executor fails only its in-flight chunk (joined items included),
+        staged chunks reroute, zero hung tickets, the survivor keeps
+        serving and submit-time joins skip the corpse."""
+        q = _queue(2, max_wait_ms=2.0)
+        try:
+            with robust.FaultPlan([robust.FaultSpec(
+                    SERVE_SITE, "worker_crash", executor=0)]):
+                ts = [q.submit("gesv", _dd(8, s), _rhs(8))
+                      for s in range(40)]
+                failed = ok = 0
+                for t in ts:
+                    try:
+                        _, info = t.result(timeout=60.0)
+                        assert info == 0
+                        ok += 1
+                    except SlateError as e:
+                        assert "worker thread died" in str(e)
+                        failed += 1
+                # only the chunk in flight on the dying executor fails —
+                # join_max bounds it at max_batch even with joins
+                assert 1 <= failed <= 4
+                assert ok == len(ts) - failed
+            assert q.capacity_fraction() == 0.5
+            t = q.submit("gesv", _dd(8, 99), _rhs(8))
+            assert t.result(timeout=60.0)[1] == 0
+            assert t.executor == "ex1"
+        finally:
+            q.close()

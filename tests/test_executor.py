@@ -111,6 +111,51 @@ class TestResidencyRouting:
             q.close()
 
 
+class TestSlotLadderWarmup:
+    def test_warmup_slot_ladder_pins_pool_wide_compiles(self):
+        """Continuous batching's compile-count property: ``warmup``
+        compiles one executable per (routine, bucket, slot rung) in EVERY
+        pool cache, and then NO occupancy a rolling dispatch can produce
+        compiles anything anywhere — each chunk rounds to a warm rung and
+        ghost slots fill the rest."""
+        q = _queue(2, max_batch=4, batch_dims=(1, 4), continuous=True)
+        try:
+            n = q.warmup([("gesv", 8, 8, 1)])
+            assert n == 2                      # one per batch rung, 1 and 4
+            assert [c.stats()["misses"] for c in q.pool.caches()] == [2, 2]
+            # uneven occupancies — 3 rounds up to nb=4 (one ghost slot),
+            # a lone request runs at nb=1 — all on warm executables
+            for count in (3, 1, 2):
+                ts = [q.submit("gesv", _dd(8, s), _rhs(8))
+                      for s in range(count)]
+                for t in ts:
+                    assert t.result(timeout=120.0)[1] == 0
+            assert [c.stats()["misses"] for c in q.pool.caches()] == [2, 2]
+        finally:
+            q.close()
+
+    def test_cache_warmup_slots_compile_ladder_directly(self):
+        """``ExecutableCache.warmup(slots=...)``: with a ladder, ``shapes``
+        describe ONE element and each rung compiles its own batched
+        variant; without, the legacy single-executable behavior holds."""
+        from slate_tpu.serve import batched as _batched
+        from slate_tpu.serve.cache import Options
+
+        cache = ExecutableCache()
+        shapes = [((8, 8), np.float32), ((8, 1), np.float32)]
+        n = cache.warmup("gesv_batched",
+                         _batched.batched_build("gesv_batched"),
+                         shapes, Options(), slots=(1, 4))
+        assert n == 2
+        assert cache.stats()["misses"] == 2
+        # re-warming the same ladder is all hits
+        cache.warmup("gesv_batched",
+                     _batched.batched_build("gesv_batched"),
+                     shapes, Options(), slots=(1, 4))
+        assert cache.stats()["misses"] == 2
+        assert cache.stats()["hits"] == 2
+
+
 class TestWorkStealing:
     def test_backed_up_resident_executor_loses_chunks(self):
         # max_batch=1: every request is its own chunk; warm ONLY ex0 so

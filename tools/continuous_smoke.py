@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CI continuous-smoke: continuous batching on CPU (ISSUE 18).
+
+Four gates (the ci.yml ``continuous-smoke`` step fails on any):
+
+* **Latency**: interleaved flush-vs-continuous A/B at equal paced
+  interactive load — continuous-mode queue_wait p50 must come in at or
+  below flush mode's (the fixed-wait tax it exists to remove), with warm
+  closed-loop throughput within ``WARM_FLOOR`` of flush mode.
+* **Divergence**: ZERO bytewise divergence — the same awaited request
+  groups at equal slot capacity (single-rung batch ladder, so both modes
+  run the same compiled nb) produce BIT-identical solutions in flush and
+  continuous modes.
+* **Overload parity**: the PR-7 overload-survival contract holds
+  unchanged with ``continuous=True`` — zero interactive sheds, zero hung
+  tickets, zero unexpected worker errors, full capacity retained.
+* **Observability**: the continuous-batching evidence is in the exported
+  registry — ``slate_serve_pad_waste_elems_total``,
+  ``slate_serve_pad_fraction``, ``slate_serve_slot_joins_total``.
+
+Artifacts: ``continuous_metrics.json``.  Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from force_cpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+#: continuous warm throughput floor vs flush mode (the acceptance bound)
+WARM_FLOOR = 0.9
+OVERLOAD_DURATION_S = 10.0
+
+
+def _ab_policy():
+    from slate_tpu.serve.queue import BucketPolicy
+
+    # a tight ladder bounds the per-run warmup compile bill on CI runners
+    return BucketPolicy(dims=(16, 32), nrhs_dims=(1, 4),
+                        batch_dims=(1, 4, 16), max_batch=16)
+
+
+def _bit_identity_failures():
+    """Serve the same awaited max-batch groups per routine in flush and
+    continuous modes at equal slot capacity (single-rung ladder: every
+    dispatch runs nb=4 whatever its occupancy) and compare bytewise."""
+    import numpy as np
+
+    from slate_tpu import serve
+    from slate_tpu.serve.cache import ExecutableCache
+    from slate_tpu.serve.queue import BucketPolicy
+
+    def groups_for(routine):
+        rng = np.random.default_rng(7)
+        out = []
+        for _ in range(3):
+            reqs = []
+            for _ in range(4):
+                n = 8
+                if routine == "gels":
+                    a = rng.standard_normal((2 * n, n)).astype(np.float32)
+                    b = rng.standard_normal((2 * n, 1)).astype(np.float32)
+                    reqs.append((routine, a, b))
+                    continue
+                if routine == "posv":
+                    g = rng.standard_normal((n, n)).astype(np.float32)
+                    a = (g @ g.T + n * np.eye(n)).astype(np.float32)
+                else:
+                    a = rng.standard_normal((n, n)).astype(np.float32) \
+                        + n * np.eye(n, dtype=np.float32)
+                b = rng.standard_normal((n, 1)).astype(np.float32)
+                reqs.append((routine, a, b))
+            out.append(reqs)
+        return out
+
+    def run(continuous, groups):
+        policy = BucketPolicy(max_batch=4, batch_dims=(4,),
+                              max_wait_ms=500.0)
+        q = serve.ServeQueue(policy=policy, cache=ExecutableCache(),
+                             executors=2, continuous=continuous)
+        try:
+            solved = []
+            for g in groups:
+                ts = [q.submit(r, a, b) for r, a, b in g]
+                solved.append([t.result(timeout=120.0) for t in ts])
+            return solved
+        finally:
+            q.close()
+
+    failures = []
+    for routine in ("gesv", "posv", "gels"):
+        groups = groups_for(routine)
+        ref = run(False, groups)
+        got = run(True, groups)
+        for gi, (gr, gg) in enumerate(zip(ref, got)):
+            for (xr, ir), (xg, ig) in zip(gr, gg):
+                if int(ir) != 0 or int(ig) != 0:
+                    failures.append(f"{routine} group {gi}: nonzero info "
+                                    f"(flush={int(ir)}, "
+                                    f"continuous={int(ig)})")
+                elif np.asarray(xr).tobytes() != np.asarray(xg).tobytes():
+                    failures.append(f"{routine} group {gi}: continuous "
+                                    "solution DIVERGES bytewise from flush")
+    return failures
+
+
+def main() -> int:
+    from slate_tpu import obs, serve
+
+    failures = []
+
+    # -- latency gate (interleaved A/B at equal offered load) ----------------
+    ab = serve.run_continuous_ab(num_requests=250, seed=0, rounds=2,
+                                 executors=2, dims=(8, 13),
+                                 policy=_ab_policy())
+    qw = ab["queue_wait_p50_ms"]
+    if qw["flush"] is None or qw["continuous"] is None:
+        failures.append(f"queue_wait p50 missing from the A/B: {qw}")
+    elif qw["continuous"] > qw["flush"]:
+        failures.append(
+            f"continuous queue_wait p50 {qw['continuous']}ms above flush "
+            f"{qw['flush']}ms at equal offered load "
+            f"({ab['offered_rate']} req/s)")
+    if ab["warm_ratio"] < WARM_FLOOR:
+        failures.append(f"continuous warm throughput fell to "
+                        f"{ab['warm_ratio']:.2f}x of flush mode "
+                        f"(floor {WARM_FLOOR})")
+
+    # -- divergence gate -----------------------------------------------------
+    failures += _bit_identity_failures()
+
+    # -- overload parity with continuous=True --------------------------------
+    ostats = serve.run_overload_workload(duration_s=OVERLOAD_DURATION_S,
+                                         seed=0, executors=2,
+                                         continuous=True)
+    if ostats["shed_by_lane"].get("interactive", 0):
+        failures.append(f"{ostats['shed_by_lane']['interactive']} "
+                        "interactive requests shed under continuous mode")
+    if ostats["hung"]:
+        failures.append(f"{ostats['hung']} tickets unresolved under "
+                        "continuous mode")
+    if ostats["worker_failed"]:
+        failures.append(f"{ostats['worker_failed']} unexpected worker "
+                        "errors under continuous mode")
+    if ostats["capacity_fraction_final"] != 1.0:
+        failures.append("capacity fraction degraded without any executor "
+                        f"death: {ostats['capacity_fraction_final']}")
+
+    # -- continuous-batching observability -----------------------------------
+    doc = obs.metrics_doc(source="continuous-smoke")
+    try:
+        obs.validate_metrics(doc)
+    except ValueError as e:
+        failures.append(f"metrics schema violation: {e}")
+    by_name = {m["name"]: m for m in doc["metrics"]}
+    for need in ("slate_serve_pad_waste_elems_total",
+                 "slate_serve_pad_fraction",
+                 "slate_serve_slot_joins_total"):
+        if need not in by_name:
+            failures.append(f"{need} missing from the exported registry")
+    obs.export_metrics("continuous_metrics.json",
+                       source="continuous-smoke")
+
+    print(json.dumps({
+        "ok": not failures,
+        "queue_wait_p50_ms": qw,
+        "queue_wait_p99_ms": ab["queue_wait_p99_ms"],
+        "warm_ratio": ab["warm_ratio"],
+        "offered_rate": ab["offered_rate"],
+        "slot_join_rate": ab["slot_join_rate"],
+        "slot_join_rate_closed_loop": ab["slot_join_rate_closed_loop"],
+        "overload_continuous": {
+            "admitted": ostats["admitted"], "ok": ostats["ok"],
+            "shed_by_lane": ostats["shed_by_lane"],
+            "hung": ostats["hung"],
+        },
+        "artifacts": ["continuous_metrics.json"],
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

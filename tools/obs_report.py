@@ -11,10 +11,12 @@ Inputs (all JSON documents written by the obs layer):
 * optionally a flight-recorder dump (schema ``slate_tpu.flight/v1``).
 
 Output: one markdown report — per-routine stage-latency decomposition
-(queue-wait vs execute vs pad, p50/p99 from the histogram buckets), window
-request/batch/error rates, the SLO verdict table, the rejection breakdown
-(shed / deadline-expired / worker-failed requests grouped by reason and
-lane), and the flight-recorder summary.  The CI serving-smoke step writes it next to the artifacts it
+(queue-wait vs execute vs pad, p50/p99 from the histogram buckets), the
+per-executor utilization table (with pad-waste and slot-join/staged-merge
+continuous-batching counts), the padding-waste table per (routine, bucket),
+window request/batch/error rates, the SLO verdict table, the rejection
+breakdown (shed / deadline-expired / worker-failed requests grouped by
+reason and lane), and the flight-recorder summary.  The CI serving-smoke step writes it next to the artifacts it
 renders; ``render_report`` is importable so the smoke gates on the same
 numbers it publishes.
 """
@@ -121,6 +123,52 @@ def _counter_sum(metrics_doc: Dict[str, Any], name: str,
     return 0.0
 
 
+def _counter_samples(metrics_doc: Dict[str, Any], name: str
+                     ) -> List[Dict[str, Any]]:
+    for m in metrics_doc.get("metrics", ()):
+        if m["name"] == name and m["kind"] == "counter":
+            return m["samples"]
+    return []
+
+
+def _pad_waste_table(metrics_doc: Dict[str, Any]) -> List[str]:
+    """Padding waste per (routine, bucket): dispatch-time padded-but-not-
+    real operand elements (shape pad inside real slots + whole ghost
+    slots) with the pad-fraction distribution — the signal the bucket-
+    boundary tuner (ROADMAP 3(a)) reads."""
+    samples = _counter_samples(metrics_doc,
+                               "slate_serve_pad_waste_elems_total")
+    if not samples:
+        return ["_no pad-waste samples recorded_", ""]
+    groups: Dict[Tuple[str, str], float] = {}
+    for s in samples:
+        lab = s.get("labels", {})
+        k = (lab.get("routine", "?"), lab.get("bucket", "?"))
+        groups[k] = groups.get(k, 0.0) + s["value"]
+    frac = _hist_samples(metrics_doc, "slate_serve_pad_fraction")
+    lines = ["| routine | bucket | pad waste (elems) "
+             "| pad fraction p50/p99 |", "|---|---|---|---|"]
+    from slate_tpu.obs import quantile_from_counts
+
+    for (r, b), v in sorted(groups.items()):
+        merged = _merge_counts(
+            [s for s in frac
+             if s.get("labels", {}).get("routine") == r
+             and s.get("labels", {}).get("bucket") == b])
+        if merged is None:
+            cell = "—"
+        else:
+            p50 = quantile_from_counts(*merged, 0.50)
+            p99 = quantile_from_counts(*merged, 0.99)
+            cell = f"{p50:.2f} / {p99:.2f}"
+        lines.append(f"| `{r}` | `{b}` | {int(v)} | {cell} |")
+    lines += ["", "(waste = operand elements carrying no real data at "
+              "dispatch; fraction = waste over the batch's total padded "
+              "elements — high fractions mark bucket boundaries worth "
+              "re-tuning)", ""]
+    return lines
+
+
 def _executor_table(metrics_doc: Dict[str, Any]) -> List[str]:
     """Per-executor utilization: device-busy and pad time from the
     ``executor``-labelled stage histograms, batch count, cache traffic
@@ -144,24 +192,32 @@ def _executor_table(metrics_doc: Dict[str, Any]) -> List[str]:
 
     pool_busy = sum(busy(ex_samples, ex)[0] for ex in names) or 1.0
     lines = ["| executor | batches | busy (s) | ms/batch | pad (s) "
-             "| cache hit | compile | busy share |",
-             "|---|---|---|---|---|---|---|---|"]
+             "| pad waste | cache hit | compile | busy share |",
+             "|---|---|---|---|---|---|---|---|---|"]
     for ex in names:
         b_s, b_n = busy(ex_samples, ex)
         p_s, _ = busy(pad_samples, ex)
+        waste = _counter_sum(metrics_doc,
+                             "slate_serve_pad_waste_elems_total",
+                             executor=ex)
         hits = _counter_sum(metrics_doc, "slate_serve_cache_hits_total",
                             executor=ex)
         miss = _counter_sum(metrics_doc, "slate_serve_cache_misses_total",
                             executor=ex)
         per = f"{b_s / b_n * 1e3:.2f}" if b_n else "—"
         lines.append(f"| `{ex}` | {int(b_n)} | {b_s:.3f} | {per} "
-                     f"| {p_s:.3f} | {int(hits)} | {int(miss)} "
-                     f"| {b_s / pool_busy:.0%} |")
+                     f"| {p_s:.3f} | {int(waste)} | {int(hits)} "
+                     f"| {int(miss)} | {b_s / pool_busy:.0%} |")
     steals = _counter_sum(metrics_doc, "slate_serve_steals_total")
     requeued = _counter_sum(metrics_doc, "slate_serve_requeued_chunks_total")
+    joins = _counter_sum(metrics_doc, "slate_serve_slot_joins_total")
+    merges = _counter_sum(metrics_doc, "slate_serve_staged_merges_total")
     lines += ["", f"({len(names)} executors; {int(steals)} chunks "
-              f"work-stolen, {int(requeued)} requeued by death drains; "
-              "busy share = this executor's device time over the pool's)",
+              f"work-stolen, {int(requeued)} requeued by death drains, "
+              f"{int(joins)} requests slot-joined + {int(merges)} chunks "
+              "staged-merged (continuous batching); busy share = this "
+              "executor's device time over the pool's; pad waste = padded "
+              "elements carrying no real data)",
               ""]
     return lines
 
@@ -295,6 +351,7 @@ def render_report(ts_doc: Dict[str, Any],
         md += _stage_table(metrics_doc)
         md += ["## Per-executor utilization", "",
                *_executor_table(metrics_doc)]
+        md += ["## Padding waste", "", *_pad_waste_table(metrics_doc)]
     else:
         md += ["_no metrics.json supplied_", ""]
     md += ["## Window rates", "", *_window_table(ts_doc),
